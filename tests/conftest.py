@@ -1,0 +1,52 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+The reference tests run under torchrun on 8 real GPUs (ref:
+scripts/launch.sh). Here every test runs on a virtual 8-device CPU mesh
+(--xla_force_host_platform_device_count=8) with Pallas TPU kernels in
+interpret mode, which simulates inter-chip remote DMA + semaphores, so the
+full distributed kernel library is exercised without TPU hardware. On a real
+TPU slice the same tests run natively (set TDT_TEST_TPU=1).
+"""
+
+import os
+
+if os.environ.get("TDT_TEST_TPU", "") != "1":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax  # noqa: F401
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """1-D tp mesh over all (8 virtual) devices."""
+    from triton_dist_tpu.runtime import make_mesh
+
+    return make_mesh(axis_names=("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2d():
+    """2-D (dp=2, tp=4) mesh."""
+    from triton_dist_tpu.runtime import make_mesh
+
+    return make_mesh(mesh_shape=(2, 4), axis_names=("dp", "tp"))
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

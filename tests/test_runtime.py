@@ -1,0 +1,65 @@
+"""Runtime bring-up tests (ref analog: test/nvidia/test_utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.runtime import (
+    initialize_distributed,
+    get_default_mesh,
+    finalize_distributed,
+    make_mesh,
+    num_ranks,
+    symm_tensor,
+    SymmetricWorkspace,
+    perf_func,
+    assert_allclose,
+)
+
+
+def test_initialize_and_default_mesh():
+    mesh = initialize_distributed()
+    assert get_default_mesh() is mesh
+    assert num_ranks(mesh, "tp") == len(jax.devices())
+    finalize_distributed()
+    with pytest.raises(RuntimeError):
+        get_default_mesh()
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh((2, 4), ("dp", "tp"))
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+
+
+def test_symm_tensor_shape_and_sharding(mesh8):
+    t = symm_tensor((4, 128), dtype=jnp.float32, mesh=mesh8)
+    assert t.shape == (8, 4, 128)
+    # each device holds exactly one leading-dim shard
+    assert len(t.addressable_shards) == 8
+    for s in t.addressable_shards:
+        assert s.data.shape == (1, 4, 128)
+
+
+def test_symm_workspace_caches(mesh8):
+    ws = SymmetricWorkspace(mesh8)
+    a = ws.get("buf", (4, 128))
+    b = ws.get("buf", (4, 128))
+    assert a is b
+    c = ws.get("buf", (8, 128))
+    assert c is not a
+    ws.free()
+
+
+def test_perf_func_runs():
+    x = jnp.ones((64, 64))
+    f = jax.jit(lambda: x @ x)
+    out, ms = perf_func(f, iters=3, warmup_iters=1)
+    assert ms > 0
+    assert out.shape == (64, 64)
+
+
+def test_assert_allclose_reports_mismatch():
+    with pytest.raises(AssertionError, match="mismatched"):
+        assert_allclose(np.zeros(4), np.ones(4))
+    assert_allclose(np.ones(4), np.ones(4))

@@ -1,0 +1,162 @@
+"""Device-primitive tests: put/signal/wait/barrier over the CPU mesh.
+
+Analog of the reference's primitive tests `test_distributed_wait.py`,
+`test_notify.py`, `test_nvshmem_api.py` (ref: python/triton_dist/test/nvidia/)
+and tutorial 01 (notify-wait producer/consumer queue).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import shmem
+
+
+def _collective_call(mesh, kernel, x, out_shape=None, collective_id=0,
+                     scratch_shapes=(), mem=pl.ANY):
+    """Run `kernel` as a collective pallas_call across the tp axis."""
+    out_shape = out_shape or jax.ShapeDtypeStruct(
+        (x.shape[0] // mesh.shape["tp"],) + x.shape[1:], x.dtype
+    )
+
+    def per_device(xs):
+        return dl.tpu_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=mem)],
+            out_specs=pl.BlockSpec(memory_space=mem),
+            scratch_shapes=list(scratch_shapes),
+            compiler_params=dl.compiler_params(
+                has_side_effects=True, collective_id=collective_id
+            ),
+        )(xs)
+
+    f = jax.shard_map(
+        per_device, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"), check_vma=False
+    )
+    return jax.jit(f)(x)
+
+
+def test_ring_shift_put(mesh8):
+    """Each rank puts its shard to rank+1 (ref: tutorials/01, kernels p2p.py)."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        h = shmem.putmem_nbi(o_ref, x_ref, send_sem, recv_sem, dst, "tp")
+        h.wait()  # waits send (local) and recv (our own incoming)
+
+    x = jnp.arange(8 * 4 * 128, dtype=jnp.float32).reshape(8 * 4, 128)
+    y = _collective_call(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA])
+    xs = np.asarray(x).reshape(8, 4, 128)
+    ys = np.asarray(y).reshape(8, 4, 128)
+    for r in range(8):
+        np.testing.assert_allclose(ys[(r + 1) % 8], xs[r])
+
+
+def test_notify_wait_producer_consumer(mesh8):
+    """Tutorial-01 analog: rank r produces a value into rank r+1's inbox and
+    notifies; consumer waits on the signal before reading the inbox."""
+
+    def kernel(x_ref, o_ref, inbox, send_sem, recv_sem, sig):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        # producer: put payload into dst's inbox, then notify dst.
+        h = shmem.putmem_signal_nbi(
+            inbox, x_ref, send_sem, recv_sem, sig, 1, dl.SIGNAL_ADD, dst, "tp"
+        )
+        # consumer: wait for notify (and for payload delivery), then publish.
+        shmem.signal_wait_until(sig, dl.CMP_GE, 1)
+        h.wait_recv()
+        o_ref[...] = inbox[...] * 2.0
+
+    x = jnp.arange(8 * 4 * 128, dtype=jnp.float32).reshape(8 * 4, 128)
+    y = _collective_call(
+        mesh8, kernel, x, collective_id=1, mem=pltpu.VMEM,
+        scratch_shapes=[
+            pltpu.VMEM((4, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    xs = np.asarray(x).reshape(8, 4, 128)
+    ys = np.asarray(y).reshape(8, 4, 128)
+    for r in range(8):
+        np.testing.assert_allclose(ys[(r + 1) % 8], xs[r] * 2.0)
+
+
+def test_barrier_all(mesh8):
+    """barrier_all completes without deadlock and all ranks proceed
+    (ref: common_ops.py:142-217 barrier_all_intra_node)."""
+
+    def kernel(x_ref, o_ref):
+        shmem.barrier_all("tp")
+        o_ref[...] = x_ref[...] + 1.0
+
+    x = jnp.zeros((8 * 4, 128), jnp.float32)
+    y = _collective_call(mesh8, kernel, x, collective_id=2, mem=pltpu.VMEM)
+    np.testing.assert_allclose(np.asarray(y), np.ones((8 * 4, 128)))
+
+
+def test_wait_consume_token_api(mesh8):
+    """dl.wait/notify/consume_token surface (ref: test_distributed_wait.py)."""
+
+    def kernel(x_ref, o_ref, sig, send_sem, recv_sem, scratch):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        h = shmem.putmem_nbi(scratch, x_ref, send_sem, recv_sem, dst, "tp")
+        h.wait_send()
+        dl.notify(sig, dst, 1, axis="tp")
+        token = dl.wait(sig, num_barriers=1)
+        h.wait_recv()
+        o_ref[...] = dl.consume_token(scratch[...], token)
+
+    x = jnp.arange(8 * 4 * 128, dtype=jnp.float32).reshape(8 * 4, 128)
+    y = _collective_call(
+        mesh8, kernel, x, collective_id=3, mem=pltpu.VMEM,
+        scratch_shapes=[
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((4, 128), jnp.float32),
+        ],
+    )
+    xs = np.asarray(x).reshape(8, 4, 128)
+    ys = np.asarray(y).reshape(8, 4, 128)
+    for r in range(8):
+        np.testing.assert_allclose(ys[(r + 1) % 8], xs[r])
+
+
+def test_my_pe_n_pes_2d(mesh2d):
+    """Teams-as-axes: rank along one axis of a 2-D mesh."""
+
+    def per_device():
+        def kernel(o_ref):
+            o_ref[0] = dl.rank("tp")
+            o_ref[1] = dl.rank("dp")
+
+        return dl.tpu_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((2,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        )()
+
+    f = jax.shard_map(
+        per_device, mesh=mesh2d, in_specs=(), out_specs=P(("dp", "tp")),
+        check_vma=False,
+    )
+    out = np.asarray(jax.jit(f)()).reshape(2, 4, 2)
+    for d in range(2):
+        for t in range(4):
+            assert out[d, t, 0] == t and out[d, t, 1] == d

@@ -1,0 +1,38 @@
+"""triton_dist_tpu — a TPU-native distributed overlapping-kernel framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+Triton-distributed (ByteDance Seed): device-side communication primitives
+(wait/notify/put/get/signal over semaphores + async remote DMA on ICI),
+a library of computation-communication overlapping kernels (AG+GEMM,
+GEMM+RS, AllReduce, GEMM+AR, low-latency MoE AllToAll, EP dispatch/combine,
+sequence-parallel AG attention, distributed flash-decode), TP/SP/EP/PP model
+layers, an end-to-end LLM inference engine, a single-persistent-kernel
+"megakernel" scheduler, contextual autotuning and AOT export.
+
+Layer map (mirrors reference SURVEY.md table; reference = Triton-distributed):
+  runtime/   - host runtime: mesh init, symmetric buffers, profiling
+               (ref: python/triton_dist/utils.py)
+  lang/      - device-side primitive layer usable inside Pallas kernels
+               (ref: python/triton_dist/language/, libshmem_device)
+  kernels/   - overlapping collective + compute kernels
+               (ref: python/triton_dist/kernels/nvidia/)
+  layers/    - TP/SP/EP/PP parallel model layers
+               (ref: python/triton_dist/layers/nvidia/)
+  models/    - model configs, dense + MoE LLMs, KV cache, inference engine
+               (ref: python/triton_dist/models/)
+  megakernel/- single persistent-kernel task-graph scheduler
+               (ref: python/triton_dist/mega_triton_kernel/)
+  tools/     - contextual autotuner, AOT export, profiling tools
+               (ref: python/triton_dist/tools/, autotuner.py)
+  csrc/      - native C++ host components (tile swizzle, MoE align,
+               megakernel scheduler) bound via ctypes
+"""
+
+__version__ = "0.1.0"
+
+from triton_dist_tpu.runtime import (  # noqa: F401
+    initialize_distributed,
+    get_default_mesh,
+    set_default_mesh,
+    finalize_distributed,
+)

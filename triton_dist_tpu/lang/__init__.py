@@ -1,0 +1,75 @@
+"""Device-side language layer (the reference's `triton_dist.language` analog).
+
+The reference's distributed dialect has 7 ops — wait, consume_token,
+get_rank, get_num_ranks, symm_at, notify, extern_call
+(ref: include/TritonDistributed/Dialect/Distributed/IR/DistributedOps.td:45-190).
+On TPU these become Pallas semaphore/DMA operations; `symm_at` (translate a
+symmetric address to a remote PE's address) has no analog because remote
+memory is only reachable through explicit DMA — the `putmem`/`getmem`
+family in `lang.shmem` covers those uses. `extern_call` (call into a device
+bitcode library) has no TPU equivalent and is intentionally absent: Mosaic
+kernels are closed-world.
+
+The SIMT escape hatch (simt_exec_region/load_shared/store_shared,
+ref: SIMTOps.td:48-127) is also unnecessary: Pallas kernels already mix
+scalar (SMEM) and tile (VMEM) code freely.
+"""
+
+from triton_dist_tpu.lang import shmem  # noqa: F401
+from triton_dist_tpu.lang.core import (  # noqa: F401
+    tpu_call,
+    use_interpret,
+    cdiv,
+    round_up,
+    min_tile,
+    compiler_params,
+    compute_vmem_bytes,
+)
+from triton_dist_tpu.lang.shmem import (  # noqa: F401
+    my_pe,
+    n_pes,
+    SIGNAL_SET,
+    SIGNAL_ADD,
+    CMP_EQ,
+    CMP_GE,
+)
+
+import jax
+
+
+def rank(axis="tp"):
+    """Device-side rank (ref: distributed_ops.py:57-111 `rank`)."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis="tp"):
+    """Device-side team size (ref: distributed_ops.py `num_ranks`)."""
+    return jax.lax.axis_size(axis)
+
+
+def wait(sem, num_barriers=1, scope="gpu", semantic="acquire", wait_value=1):
+    """Spin-wait on `num_barriers` signals (ref: DistributedOps.td:45 `wait`).
+
+    Maps to a consuming semaphore wait for num_barriers*wait_value. scope and
+    semantic are accepted for parity; Pallas semaphore waits are always
+    device-scope acquire. Returns a token for `consume_token`."""
+    del scope, semantic
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.semaphore_wait(sem, num_barriers * wait_value)
+    return 0
+
+
+def consume_token(value, token):
+    """Artificial dependency between a wait and subsequent loads
+    (ref: DistributedOps.td:79 `consume_token`). Pallas kernels execute
+    semaphore ops in program order relative to ref loads, so this is an
+    identity; kept so ported kernel code reads the same."""
+    del token
+    return value
+
+
+def notify(sem, pe, signal_val=1, sig_op=SIGNAL_ADD, comm_scope="intra_node", axis="tp"):
+    """Set/add a signal on `pe` (ref: DistributedOps.td:151 `notify`)."""
+    del comm_scope
+    shmem.signal(sem, signal_val, sig_op, pe, axis)

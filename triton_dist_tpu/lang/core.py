@@ -1,0 +1,87 @@
+"""Core Pallas helpers: backend-aware pallas_call, tiling utilities.
+
+This is the foundation of the device-side language layer
+(ref: python/triton_dist/language/core.py). Every kernel in the framework is
+built through `tpu_call`, which compiles natively on TPU and transparently
+switches to Pallas TPU interpret mode on CPU so the full kernel library —
+including inter-chip remote DMA — runs on a virtual
+`--xla_force_host_platform_device_count` mesh for testing.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_FORCE_INTERPRET = os.environ.get("TDT_FORCE_INTERPRET", "") == "1"
+
+
+@functools.lru_cache(maxsize=None)
+def backend_platform() -> str:
+    return jax.devices()[0].platform
+
+
+def use_interpret() -> bool:
+    """True when Pallas TPU kernels must run in interpreter mode (CPU mesh)."""
+    return _FORCE_INTERPRET or backend_platform() != "tpu"
+
+
+def tpu_call(kernel, **kwargs):
+    """pl.pallas_call with automatic interpret-mode fallback off-TPU."""
+    if use_interpret() and "interpret" not in kwargs:
+        kwargs["interpret"] = pltpu.InterpretParams()
+    return pl.pallas_call(kernel, **kwargs)
+
+
+def cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def min_tile(dtype) -> tuple:
+    """Minimum (sublane, lane) tile for a dtype on TPU."""
+    d = jnp.dtype(dtype)
+    if d.itemsize == 4:
+        return (8, 128)
+    if d.itemsize == 2:
+        return (16, 128)
+    return (32, 128)
+
+
+def compute_vmem_bytes(*shaped) -> int:
+    """Sum byte sizes of (shape, dtype) pairs or arrays, for vmem_limit."""
+    import math
+
+    total = 0
+    for s in shaped:
+        if hasattr(s, "shape") and hasattr(s, "dtype"):
+            shape, dtype = s.shape, s.dtype
+        else:
+            shape, dtype = s
+        total += math.prod(shape) * jnp.dtype(dtype).itemsize
+    return total
+
+
+def compiler_params(
+    has_side_effects: bool = False,
+    collective_id: Optional[int] = None,
+    vmem_limit_bytes: Optional[int] = None,
+    **kw: Any,
+) -> pltpu.CompilerParams:
+    args: dict = dict(kw)
+    if has_side_effects:
+        args["has_side_effects"] = True
+    if collective_id is not None:
+        args["collective_id"] = collective_id
+    if vmem_limit_bytes is not None:
+        args["vmem_limit_bytes"] = vmem_limit_bytes
+    return pltpu.CompilerParams(**args)

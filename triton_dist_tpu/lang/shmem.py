@@ -1,0 +1,249 @@
+"""Device-side OpenSHMEM-style API for Pallas TPU kernels.
+
+TPU-native re-design of the reference's `libshmem_device`
+(ref: python/triton_dist/language/extra/libshmem_device.py:28-341), which
+exposes ~70 NVSHMEM device functions inside Triton kernels. On TPU the
+symmetric heap is replaced by per-device refs inside a shard_map'd Pallas
+kernel, remote puts are ICI async remote DMA (`pltpu.make_async_remote_copy`)
+and signals are Pallas semaphores. Teams (NVSHMEM_TEAM_WORLD/NODE, ref
+libshmem_device.py:326-340) map to mesh axis names.
+
+Semantics notes (differences from NVSHMEM, by design of the hardware):
+- ICI RDMA is push-based. `putmem*` is native; `getmem*` is provided for
+  API parity by pulling through a peer push in cooperative kernels (see
+  kernels/p2p.py) — prefer put-based algorithms.
+- Signals are counting semaphores: `SIGNAL_ADD` is native; `SIGNAL_SET` is
+  emulated (used only with value 1 on zeroed semaphores, which is equal to
+  ADD 1 — asserted).
+- `signal_wait_until(GE, v)` consumes v on success (semaphore decrement);
+  all framework call sites are matched signal/wait pairs so this is
+  invisible, and it is what makes kernels re-entrant without a re-zeroing
+  pass (the reference needs explicit barrier-reset, e.g.
+  allgather_gemm.py:107 local_copy_and_barrier_all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Union
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# --- signal ops / comparison constants (ref: libshmem_device.py:293-323) ---
+SIGNAL_SET = 0
+SIGNAL_ADD = 1
+CMP_EQ = 0
+CMP_NE = 1
+CMP_GT = 2
+CMP_LE = 3
+CMP_LT = 4
+CMP_GE = 5
+
+# Teams = mesh axes. TEAM_WORLD means "all named axes of the surrounding
+# shard_map" and must be spelled explicitly by kernels (axis or tuple).
+TEAM_WORLD = None
+
+AxisName = Union[str, Sequence[str]]
+
+
+def my_pe(axis: AxisName) -> jax.Array:
+    """This device's rank within the team (ref: nvshmem_my_pe)."""
+    return jax.lax.axis_index(axis)
+
+
+def n_pes(axis: AxisName) -> jax.Array:
+    """Team size (ref: nvshmem_n_pes)."""
+    return jax.lax.axis_size(axis)
+
+
+def team_device_id(axis: AxisName, pe) -> dict:
+    """Mesh-coordinate device id addressing `pe` along `axis`, holding all
+    other mesh axes at this device's coordinates (NVSHMEM team translate,
+    ref: nvshmem_team_translate_pe)."""
+    if isinstance(axis, str):
+        return {axis: pe}
+    raise NotImplementedError(
+        "multi-axis teams: linearize explicitly with team_linear_device_id"
+    )
+
+
+def team_linear_device_id(axes: Sequence[str], pe) -> dict:
+    """Address flat rank `pe` within the team spanned by `axes` (row-major)."""
+    coords = {}
+    rem = pe
+    for ax in reversed(axes):
+        size = jax.lax.axis_size(ax)
+        coords[ax] = jax.lax.rem(rem, size)
+        rem = jax.lax.div(rem, size)
+    return coords
+
+
+@dataclasses.dataclass(frozen=True)
+class PutHandle:
+    """Handle for a non-blocking put (ref: *_nbi variants + quiet)."""
+
+    copy: Any
+
+    def wait_send(self):
+        self.copy.wait_send()
+
+    def wait_recv(self):
+        """Wait for the symmetric incoming payload on this device's recv_sem
+        (every rank runs the same program, so 'my put's recv' is 'my inbox')."""
+        self.copy.wait_recv()
+
+    def wait(self):
+        self.copy.wait()
+
+
+def putmem_nbi(
+    dst_ref,
+    src_ref,
+    send_sem,
+    recv_sem,
+    pe,
+    axis: AxisName,
+) -> PutHandle:
+    """Non-blocking put of src_ref (local) into dst_ref on `pe` of team `axis`
+    (ref: nvshmem_putmem_nbi_block, libshmem_device.py:150-180).
+
+    recv_sem is incremented ON THE DESTINATION when the payload lands —
+    i.e. every put is implicitly a put-with-signal; `putmem_signal_nbi`
+    below only differs by signal amount.
+    """
+    copy = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=team_device_id(axis, pe),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    copy.start()
+    return PutHandle(copy)
+
+
+def putmem(dst_ref, src_ref, send_sem, recv_sem, pe, axis: AxisName) -> None:
+    """Blocking put: returns when the local buffer is reusable
+    (ref: nvshmem_putmem_block)."""
+    putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe, axis).wait_send()
+
+
+def putmem_signal_nbi(
+    dst_ref,
+    src_ref,
+    send_sem,
+    recv_sem,
+    sig_sem,
+    signal_val,
+    sig_op,
+    pe,
+    axis: AxisName,
+) -> PutHandle:
+    """Put + remote signal (ref: nvshmem_putmem_signal_nbi_block).
+
+    TPU contract (WEAKER than NVSHMEM's — by hardware design): the named
+    signal is a separate message issued after the local send completes; it
+    does NOT imply the payload is visible at the destination. Payload
+    visibility is carried by `recv_sem`, which the destination must wait via
+    `PutHandle.wait_recv()` (every put on TPU is already put-with-signal
+    through its delivery semaphore). Receivers therefore pair
+    `signal_wait_until(sig,...)` with `h.wait_recv()`; the named signal is
+    for counting/ordering across peers, the recv_sem for data visibility.
+    All framework call sites follow this pairing."""
+    h = putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe, axis)
+    h.wait_send()
+    signal(sig_sem, signal_val, sig_op, pe, axis)
+    return h
+
+
+def signal(sig_sem, value, sig_op, pe, axis: AxisName) -> None:
+    """Remote signal op on `pe`'s semaphore (ref: nvshmemx_signal_op).
+
+    TPU semaphores are counting: only ADD is native. SET is accepted solely
+    for the ubiquitous "set flag to 1 on a zeroed semaphore" pattern, where
+    it equals ADD 1 — enforced below."""
+    assert sig_op in (SIGNAL_SET, SIGNAL_ADD), f"unknown sig_op {sig_op}"
+    if sig_op == SIGNAL_SET:
+        assert isinstance(value, int) and value == 1, (
+            "SIGNAL_SET on TPU is only supported as set-to-1 on a zeroed "
+            "semaphore (== ADD 1); use SIGNAL_ADD otherwise"
+        )
+    pltpu.semaphore_signal(
+        sig_sem,
+        inc=value,
+        device_id=team_device_id(axis, pe),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+
+
+def signal_local(sig_sem, value=1) -> None:
+    """Signal this device's own semaphore."""
+    pltpu.semaphore_signal(sig_sem, inc=value)
+
+
+def signal_wait_until(sig_sem, cmp, value) -> None:
+    """Wait for local semaphore (ref: nvshmem_signal_wait_until).
+
+    Consuming wait: decrements by `value` once satisfied (see module doc).
+    Only CMP_GE is supported — TPU semaphore waits are ">= then subtract";
+    NVSHMEM's EQ (wait for exact value, non-consuming) cannot be expressed."""
+    assert cmp == CMP_GE, "TPU signal_wait_until supports CMP_GE only"
+    pltpu.semaphore_wait(sig_sem, value)
+
+
+def signal_read(sig_sem) -> jax.Array:
+    """Non-destructive semaphore read (ref: atomic load of signal word)."""
+    return pl.semaphore_read(sig_sem)
+
+
+def fence() -> None:
+    """Ordering fence (ref: nvshmem_fence). ICI delivers a single
+    connection's DMAs in order and Pallas semaphore ops are program-ordered,
+    so this is a no-op retained for API parity."""
+
+
+def quiet(*handles: PutHandle) -> None:
+    """Complete outstanding nbi puts (ref: nvshmem_quiet)."""
+    for h in handles:
+        h.wait_send()
+
+
+def barrier_all(axis: AxisName) -> None:
+    """Full-team barrier inside a kernel (ref: nvshmem_barrier_all /
+    __syncthreads-free barrier_all_block, kernels/nvidia/common_ops.py:142-217).
+
+    Signals every team member's global barrier semaphore, then waits for the
+    whole team. O(n) signals over ICI; fine for the n<=8-per-axis meshes this
+    targets per hop. Requires the surrounding pallas_call to set a
+    collective_id (compiler_params) so all devices agree on the barrier
+    semaphore."""
+    if isinstance(axis, str):
+        n = jax.lax.axis_size(axis)
+    else:
+        n = 1
+        for ax in axis:
+            n = n * jax.lax.axis_size(ax)
+    bsem = pltpu.get_barrier_semaphore()
+
+    def body(i, _):
+        pltpu.semaphore_signal(
+            bsem,
+            inc=1,
+            device_id=team_device_id(axis, i)
+            if isinstance(axis, str)
+            else team_linear_device_id(axis, i),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        return _
+
+    jax.lax.fori_loop(0, n, body, None)
+    pltpu.semaphore_wait(bsem, n)
+
+
+def sync_all(axis: AxisName) -> None:
+    """Alias of barrier_all — on TPU there is no separate 'quiet' phase
+    because delivery semaphores already track payload arrival."""
+    barrier_all(axis)

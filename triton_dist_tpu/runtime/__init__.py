@@ -1,0 +1,33 @@
+"""Host runtime: distributed bring-up, meshes, symmetric buffers, perf utils.
+
+TPU-native analog of the reference's host runtime
+(ref: python/triton_dist/utils.py:182-205 `initialize_distributed`,
+:114-176 symmetric tensors, :274-318 perf/printing).
+"""
+
+from triton_dist_tpu.runtime.init import (  # noqa: F401
+    initialize_distributed,
+    finalize_distributed,
+    get_default_mesh,
+    set_default_mesh,
+    make_mesh,
+    rank,
+    num_ranks,
+    init_seed,
+    TP_AXIS,
+    EP_AXIS,
+    SP_AXIS,
+    PP_AXIS,
+    DP_AXIS,
+)
+from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
+    symm_tensor,
+    symm_zeros,
+    SymmetricWorkspace,
+)
+from triton_dist_tpu.runtime.utils import (  # noqa: F401
+    dist_print,
+    perf_func,
+    assert_allclose,
+    group_profile,
+)

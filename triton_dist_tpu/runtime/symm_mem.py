@@ -1,0 +1,89 @@
+"""Symmetric-memory abstraction for TPU.
+
+The reference allocates NVSHMEM symmetric tensors: one same-shaped buffer per
+rank, remotely addressable (ref: python/triton_dist/utils.py:114-176
+`nvshmem_create_tensor(s)`). On TPU the analog is a sharded jax.Array over a
+mesh axis: each device owns an identically-shaped shard in its HBM, and
+Pallas kernels running under shard_map address peers' shards via async remote
+DMA (`pltpu.make_async_remote_copy`) with mesh-logical device ids. There is
+no persistent heap to manage — XLA owns allocation — so "symmetric tensors"
+are ordinary arrays with a guaranteed uniform per-device local shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.runtime.init import get_default_mesh, TP_AXIS
+
+
+def symm_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """Sharding that gives every device along `axis` one leading-dim shard."""
+    return NamedSharding(mesh, P(axis))
+
+
+def symm_tensor(
+    local_shape: Tuple[int, ...],
+    dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
+    axis: str = TP_AXIS,
+    fill: Optional[float] = None,
+) -> jax.Array:
+    """Allocate a symmetric tensor: global shape (n_ranks, *local_shape),
+    sharded along the leading dim so each device holds `local_shape`.
+
+    Analog of `nvshmem_create_tensors` returning per-rank peer views
+    (ref: utils.py:121-136).
+    """
+    mesh = mesh or get_default_mesh()
+    n = int(mesh.shape[axis])
+    global_shape = (n,) + tuple(local_shape)
+    if fill is None:
+        arr = jnp.zeros(global_shape, dtype=dtype)
+    else:
+        arr = jnp.full(global_shape, fill, dtype=dtype)
+    return jax.device_put(arr, symm_sharding(mesh, axis))
+
+
+def symm_zeros(local_shape, dtype=jnp.float32, mesh=None, axis=TP_AXIS):
+    return symm_tensor(local_shape, dtype=dtype, mesh=mesh, axis=axis)
+
+
+@dataclass
+class SymmetricWorkspace:
+    """A reusable bag of symmetric buffers keyed by (name, shape, dtype).
+
+    Kernel contexts in the reference own symmetric workspaces + barrier
+    tensors (ref: kernels/nvidia/allgather_gemm.py:417-487
+    `AllGatherGEMMTensorParallelContext`). On TPU, barrier words are Pallas
+    semaphores scoped to a single fused kernel, so the workspace only needs
+    data staging buffers. A caller that donates a buffer to a jit (input
+    donation deletes the array) must store the aliased output back with
+    `update()` before the next `get()`.
+    """
+
+    mesh: Mesh
+    axis: str = TP_AXIS
+    _buffers: dict = field(default_factory=dict)
+
+    def get(self, name: str, local_shape: Tuple[int, ...], dtype=jnp.float32):
+        key = (name, tuple(local_shape), jnp.dtype(dtype).name)
+        if key not in self._buffers:
+            self._buffers[key] = symm_tensor(
+                local_shape, dtype=dtype, mesh=self.mesh, axis=self.axis
+            )
+        return self._buffers[key]
+
+    def update(self, name: str, arr) -> None:
+        """Store back the aliased output of a donating kernel so the cache
+        never hands out a deleted array."""
+        key = (name, tuple(arr.shape[1:]), jnp.dtype(arr.dtype).name)
+        self._buffers[key] = arr
+
+    def free(self) -> None:
+        self._buffers.clear()

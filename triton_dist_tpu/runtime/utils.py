@@ -1,0 +1,103 @@
+"""Perf measurement, rank-filtered printing, allclose with diff dump.
+
+TPU-native analogs of the reference host utilities
+(ref: python/triton_dist/utils.py:274-318 perf_func/dist_print,
+:870-899 assert_allclose, :505-589 group_profile).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Callable, Tuple
+
+import jax
+import numpy as np
+
+
+def dist_print(*args, prefix: bool = True, allowed_ranks="0", **kwargs):
+    """Rank-filtered printing (ref: utils.py:289-318).
+
+    allowed_ranks: comma string, list of ints, or "all".
+    """
+    r = jax.process_index()
+    if allowed_ranks == "all":
+        allowed = None
+    elif isinstance(allowed_ranks, str):
+        allowed = {int(x) for x in allowed_ranks.split(",") if x != ""}
+    else:
+        allowed = set(int(x) for x in allowed_ranks)
+    if allowed is None or r in allowed:
+        if prefix:
+            print(f"[rank {r}]", *args, **kwargs)
+        else:
+            print(*args, **kwargs)
+
+
+def perf_func(
+    fn: Callable[[], jax.Array],
+    iters: int = 10,
+    warmup_iters: int = 3,
+) -> Tuple[object, float]:
+    """Time `fn` with blocking sync; returns (last_output, ms_per_iter).
+
+    The reference times with CUDA events (ref: utils.py:274-286); on TPU we
+    block on the async dispatch queue with block_until_ready, which measures
+    the same device-side wall clock once warm.
+    """
+    out = None
+    for _ in range(warmup_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    return out, (t1 - t0) * 1e3 / iters
+
+
+def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose=True):
+    """allclose with mismatch dump (ref: utils.py:870-899)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if x.shape != y.shape:
+        raise AssertionError(f"shape mismatch {x.shape} vs {y.shape}")
+    if np.allclose(x, y, atol=atol, rtol=rtol):
+        return
+    diff = np.abs(x.astype(np.float64) - y.astype(np.float64))
+    mask = diff > (atol + rtol * np.abs(y.astype(np.float64)))
+    n_bad = int(mask.sum())
+    idx = np.argwhere(mask)[:10]
+    msg = [
+        f"assert_allclose failed: {n_bad}/{x.size} mismatched "
+        f"(atol={atol}, rtol={rtol}), max_abs_diff={diff.max():.6g}"
+    ]
+    if verbose:
+        for i in idx:
+            ti = tuple(int(v) for v in i)
+            msg.append(f"  at {ti}: {x[ti]!r} vs {y[ti]!r}")
+    raise AssertionError("\n".join(msg))
+
+
+@contextlib.contextmanager
+def group_profile(name: str = "profile", do_prof: bool = True, out_dir: str = None):
+    """Profiling context writing an xplane trace per process.
+
+    The reference merges per-rank chrome traces into one
+    (ref: utils.py:505-589); on TPU jax.profiler writes a unified xplane
+    trace per host that already carries all local device lanes; TensorBoard
+    merges multi-host by directory.
+    """
+    if not do_prof:
+        yield
+        return
+    out_dir = out_dir or os.environ.get("TDT_PROFILE_DIR", "/tmp/tdt_profile")
+    path = os.path.join(out_dir, f"{name}")
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        dist_print(f"profile written to {path}")
