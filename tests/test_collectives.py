@@ -1,0 +1,137 @@
+"""Collective kernel tests: AG / RS / AR over the 8-device CPU mesh.
+
+Analog of the reference's kernel integration tests
+(ref: python/triton_dist/test/nvidia/test_all_gather.py, test_reduce_scatter.py,
+test_allreduce.py): correctness vs a numpy/XLA reference for each method.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels import (
+    AllGatherMethod,
+    AllReduceMethod,
+    ReduceScatterMethod,
+    all_gather,
+    all_reduce,
+    reduce_scatter,
+)
+
+
+def _shard_run(mesh, fn, x, in_spec=P("tp"), out_spec=P()):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                      check_vma=False)
+    )(x)
+
+
+@pytest.mark.parametrize(
+    "method",
+    [AllGatherMethod.Ring1D, AllGatherMethod.FullMesh, AllGatherMethod.XLA],
+)
+def test_all_gather_methods(mesh8, method):
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+    fn = functools.partial(all_gather, axis="tp", method=method)
+    y = _shard_run(mesh8, fn, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+def test_all_gather_bf16(mesh8):
+    x = (jnp.arange(8 * 16 * 128) % 251).astype(jnp.bfloat16).reshape(8 * 16, 128)
+    fn = functools.partial(all_gather, axis="tp", method=AllGatherMethod.Ring1D)
+    y = _shard_run(mesh8, fn, x)
+    np.testing.assert_array_equal(
+        np.asarray(y.astype(jnp.float32)), np.asarray(x.astype(jnp.float32))
+    )
+
+
+def test_all_gather_2d(mesh2d):
+    """Stage-wise AG over (dp, tp) axes gathers everything."""
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+
+    def fn(xs):
+        return all_gather(xs, ("dp", "tp"), method=AllGatherMethod.Ring1D)
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh2d, in_specs=P(("dp", "tp")), out_specs=P(),
+                      check_vma=False)
+    )(x)
+    # stage order: gather tp (within dp group), then dp. Row blocks get
+    # reordered: for dp group d the tp-gather yields rows of that group; the
+    # dp stage stacks group 0 then group 1 — identity here since the global
+    # layout is (dp, tp) row-major already.
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+@pytest.mark.parametrize(
+    "method", [ReduceScatterMethod.Ring1D, ReduceScatterMethod.XLA]
+)
+def test_reduce_scatter_methods(mesh8, method):
+    # per-rank full contribution: rank r contributes r+1 everywhere.
+    def fn():
+        r = jax.lax.axis_index("tp")
+        contrib = jnp.full((8 * 8, 128), 1.0, jnp.float32) * (r + 1)
+        return reduce_scatter(contrib, "tp", method=method)
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh8, in_specs=(), out_specs=P("tp"),
+                      check_vma=False)
+    )()
+    total = sum(range(1, 9))
+    np.testing.assert_allclose(np.asarray(y), np.full((8 * 8, 128), total))
+
+
+def test_reduce_scatter_values(mesh8):
+    """RS with rank-dependent data against a numpy reference."""
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((8, 64, 128)).astype(np.float32)
+    ref = data.sum(0)  # (64,128); rank r keeps rows r*8:(r+1)*8
+
+    def fn(xs):
+        return reduce_scatter(xs[0], "tp", method=ReduceScatterMethod.Ring1D)
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+                      check_vma=False)
+    )(jnp.asarray(data))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "method",
+    [AllReduceMethod.OneShot, AllReduceMethod.TwoShot, AllReduceMethod.XLA],
+)
+def test_all_reduce_methods(mesh8, method):
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((8, 16, 128)).astype(np.float32)
+    ref = np.broadcast_to(data.sum(0), (8, 16, 128)).reshape(8 * 16, 128)
+
+    def fn(xs):
+        return all_reduce(xs[0], "tp", method=method)
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+                      check_vma=False)
+    )(jnp.asarray(data))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_all_reduce_auto_small(mesh8):
+    """Auto picks one-shot for small tensors and matches psum."""
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((8, 8, 128)).astype(np.float32)
+    ref = np.broadcast_to(data.sum(0), (8, 8, 128)).reshape(64, 128)
+
+    def fn(xs):
+        return all_reduce(xs[0], "tp", method=AllReduceMethod.Auto)
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+                      check_vma=False)
+    )(jnp.asarray(data))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,30 @@
+"""Overlapping collective + compute kernel library.
+
+TPU-native analog of the reference kernel library
+(ref: python/triton_dist/kernels/nvidia/__init__.py:25-41). Every kernel is
+a Pallas TPU kernel (or an XLA-collective composition) designed to run
+inside `jax.shard_map` over a named mesh; host-level `*_op` wrappers apply
+the shard_map for callers holding global sharded arrays.
+"""
+
+from triton_dist_tpu.kernels.allgather import (  # noqa: F401
+    AllGatherMethod,
+    choose_allgather_method,
+    ring_all_gather,
+    full_mesh_all_gather,
+    all_gather,
+    all_gather_op,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: F401
+    ReduceScatterMethod,
+    ring_reduce_scatter,
+    reduce_scatter,
+    reduce_scatter_op,
+)
+from triton_dist_tpu.kernels.allreduce import (  # noqa: F401
+    AllReduceMethod,
+    one_shot_all_reduce,
+    two_shot_all_reduce,
+    all_reduce,
+    all_reduce_op,
+)

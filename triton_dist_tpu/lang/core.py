@@ -71,6 +71,34 @@ def compute_vmem_bytes(*shaped) -> int:
     return total
 
 
+_COLLECTIVE_IDS: dict = {}
+
+
+def next_collective_id(name: str) -> int:
+    """Stable collective_id per kernel name.
+
+    Mosaic requires every collective pallas_call to carry an id agreed on by
+    all devices; ids key the shared barrier semaphore. The id is derived
+    from the *name alone* (crc32), never from call order, so multi-controller
+    processes that trace extra rank-local programs still agree. Cross-name
+    collisions are detected per process and are a hard error (two distinct
+    collectives sharing a barrier semaphore could race if XLA overlaps
+    them)."""
+    import zlib
+
+    if name not in _COLLECTIVE_IDS:
+        # int16 space: the Pallas interpreter stores collective ids as int16.
+        cid = zlib.crc32(name.encode()) & 0x7FFF
+        for other, oid in _COLLECTIVE_IDS.items():
+            if oid == cid:
+                raise RuntimeError(
+                    f"collective_id collision: {name!r} and {other!r} both "
+                    f"hash to {cid}; rename one kernel"
+                )
+        _COLLECTIVE_IDS[name] = cid
+    return _COLLECTIVE_IDS[name]
+
+
 def compiler_params(
     has_side_effects: bool = False,
     collective_id: Optional[int] = None,

@@ -243,6 +243,21 @@ def barrier_all(axis: AxisName) -> None:
     pltpu.semaphore_wait(bsem, n)
 
 
+def neighbor_barrier(axis: str, me, n: int) -> None:
+    """Barrier with the two ring neighbors only — the standard prologue of
+    ring kernels so remote DMA never lands in a peer that has not yet
+    entered the kernel. Cheaper than barrier_all when only neighbors
+    communicate (ref: the cuStreamWriteValue barrier preambles of
+    kernels/nvidia/allgather.py:106-138)."""
+    bsem = pltpu.get_barrier_semaphore()
+    for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id={axis: d},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(bsem, 2)
+
+
 def sync_all(axis: AxisName) -> None:
     """Alias of barrier_all — on TPU there is no separate 'quiet' phase
     because delivery semaphores already track payload arrival."""
