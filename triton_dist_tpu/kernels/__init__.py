@@ -28,3 +28,17 @@ from triton_dist_tpu.kernels.allreduce import (  # noqa: F401
     all_reduce,
     all_reduce_op,
 )
+from triton_dist_tpu.kernels.allgather_gemm import (  # noqa: F401
+    AgGemmConfig,
+    ag_gemm,
+    ag_gemm_ref,
+)
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
+    GemmRsConfig,
+    gemm_rs,
+    gemm_rs_ref,
+)
+from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
+    gemm_ar,
+    gemm_ar_ref,
+)

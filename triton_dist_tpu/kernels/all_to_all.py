@@ -1,0 +1,136 @@
+"""Low-latency AllToAll — the MoE dispatch/combine transport.
+
+TPU-native re-design of the reference's DeepEP-style A2A
+(ref: python/triton_dist/kernels/nvidia/low_latency_all_to_all.py:36-118
+`all_to_all_kernel`: one block per peer does putmem_nbi_block of the token
+segment + putmem_signal of scales, fence, signal_op, then
+signal_wait_until on its own incoming segment; 137 µs on 32 ranks,
+README.md:93). On TPU the whole exchange is one Pallas kernel issuing n-1
+concurrent remote DMAs — segment i of the send buffer lands in peer i's
+slot `me` — with DMA delivery semaphores playing the role of the
+putmem_signal flags. Segment sizes are static (max tokens per peer, as jit
+requires); actual counts travel in the same kernel as a second, tiny
+`splits` transfer, mirroring the reference's split-metadata exchange
+(ref: ep_a2a.py:244-309 splits AG + recv-offset calc).
+
+The reference double-buffers by call parity so back-to-back layer calls
+don't collide (low_latency_all_to_all.py:36-118 `call_count % 2`); here
+every call's semaphores are kernel-local scratch, so calls are re-entrant
+by construction and no parity state exists.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import tpu_call, compiler_params, next_collective_id
+from triton_dist_tpu.runtime.init import EP_AXIS
+
+
+def _a2a_kernel(axis: str, n: int, x_ref, s_ref, o_ref, os_ref,
+                cp_sem, send_sem, recv_sem, meta_send_sem, meta_recv_sem):
+    me = jax.lax.axis_index(axis)
+    shmem.barrier_all(axis)
+
+    # Local segment: x[me] -> out[me]; splits likewise.
+    cp = pltpu.make_async_copy(x_ref.at[me], o_ref.at[me], cp_sem)
+    cp.start()
+    cps = pltpu.make_async_copy(s_ref.at[me], os_ref.at[me], cp_sem)
+
+    handles = []
+    for i in range(1, n):
+        peer = jnp.mod(me + i, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[peer],
+            dst_ref=o_ref.at[me],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        handles.append(rdma)
+        meta = pltpu.make_async_remote_copy(
+            src_ref=s_ref.at[peer],
+            dst_ref=os_ref.at[me],
+            send_sem=meta_send_sem,
+            recv_sem=meta_recv_sem,
+            device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        meta.start()
+        handles.append(meta)
+    cp.wait()
+    cps.start()
+    cps.wait()
+    for h in handles:
+        h.wait()
+
+
+def all_to_all(
+    x: jax.Array,
+    splits: jax.Array,
+    axis: str = EP_AXIS,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exchange per-peer segments: out[j] = peer j's x[me]. Per-device
+    function inside shard_map (ref host entry:
+    low_latency_all_to_all.py:198 `fast_all_to_all`).
+
+    x: (n, m, hidden) send buffer — segment i goes to rank i.
+    splits: (n,) int32 — actual token counts per segment.
+    Returns (out, out_splits): out[j] holds rank j's segment for us, valid
+    rows given by out_splits[j].
+    """
+    n = jax.lax.axis_size(axis)
+    if x.shape[0] != n:
+        raise ValueError(f"x leading dim {x.shape[0]} != axis size {n}")
+    splits2d = splits.reshape(n, 1).astype(jnp.int32)
+    out, out_splits = tpu_call(
+        functools.partial(_a2a_kernel, axis, n),
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(f"a2a_{axis}"),
+        ),
+    )(x, splits2d)
+    return out, out_splits.reshape(n)
+
+
+def fast_all_to_all(x, splits, axis: str = EP_AXIS):
+    """Alias matching the reference's public name
+    (ref: kernels/nvidia/__init__.py fast_all_to_all)."""
+    return all_to_all(x, splits, axis)
+
+
+def all_to_all_ref(x: jax.Array, splits: jax.Array, axis: str = EP_AXIS):
+    """XLA reference path (lax.all_to_all over the leading dim)."""
+    out = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+    out_splits = jax.lax.all_to_all(
+        splits.reshape(-1, 1), axis, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(-1)
+    return out, out_splits

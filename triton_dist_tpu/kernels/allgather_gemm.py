@@ -1,0 +1,210 @@
+"""Fused AllGather+GEMM — the flagship overlapped kernel.
+
+TPU-native re-design of the reference's AG+GEMM
+(ref: python/triton_dist/kernels/nvidia/allgather_gemm.py:158-575): there, a
+copy-engine producer pushes shards while a persistent GEMM consumer spins on
+per-rank barrier words before each M-tile (dl.wait :236, consume_token :237),
+with a rank-offset threadblock swizzle so locally-available tiles compute
+first (:224-229). Here the same overlap is ONE Pallas kernel:
+
+  grid = (n_ranks, m_tiles, n_tiles) — outer dim s is the ring step.
+  step s computes chunk (me - s) mod n: own shard at s=0 (the swizzle
+  analog: zero-wait start), while the ring forward of the previous chunk is
+  in flight. The per-rank barrier words become per-step DMA delivery
+  semaphores; `dl.wait`+`consume_token` become `wait_recv` ordered before
+  the A-tile loads by program order.
+
+Computes: C = AllGather(a_shard) @ b   [column-parallel TP matmul]
+  a_shard: (M/n, K) per device, b: (K, N_loc) per device -> C: (M, N_loc).
+Also returns the gathered A (the reference's ctx workspace is reusable by
+later kernels, allgather_gemm.py:458-487).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import (
+    tpu_call,
+    compiler_params,
+    next_collective_id,
+    cdiv,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class AgGemmConfig:
+    """Tile configuration (the reference's context tile fields,
+    ref: allgather_gemm.py:417-456 BLOCK_M/N/K, num_stages)."""
+
+    tile_m: int = 128
+    tile_n: int = 256
+    # VMEM ceiling for the auto fallback decision.
+    vmem_budget: int = 14 << 20
+
+
+def _ag_gemm_kernel(axis: str, n: int, tm: int, tn: int, out_dtype,
+                    a_ref, b_ref, ws_ref, c_ref,
+                    a_tile, acc, ld_sem, st_sem, cp_sem, send_sem, recv_sems):
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    me = jax.lax.axis_index(axis)
+    m_loc = a_ref.shape[0]
+    chunk = jnp.mod(me - s, n)
+    right = jnp.mod(me + 1, n)
+
+    def fwd_copy(c_idx, step):
+        """Ring descriptor for forwarding chunk rows to the right neighbor.
+        Reconstructed identically wherever we need to start or wait it."""
+        return pltpu.make_async_remote_copy(
+            src_ref=ws_ref.at[pl.ds(c_idx * m_loc, m_loc)],
+            dst_ref=ws_ref.at[pl.ds(c_idx * m_loc, m_loc)],
+            send_sem=send_sem,
+            recv_sem=recv_sems.at[step],
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    # --- producer side: runs once per ring step, before that step's tiles.
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _comm():
+        @pl.when(s == 0)
+        def _():
+            if n > 1:
+                shmem.neighbor_barrier(axis, me, n)
+            cp = pltpu.make_async_copy(
+                a_ref, ws_ref.at[pl.ds(me * m_loc, m_loc)], cp_sem
+            )
+            cp.start()
+            cp.wait()
+            if n > 1:
+                fwd_copy(me, 0).start()
+
+        if n > 1:
+            @pl.when(s > 0)
+            def _():
+                prev_chunk = jnp.mod(me - s + 1, n)
+                prev = fwd_copy(prev_chunk, s - 1)
+                prev.wait_send()
+                # consumer wait: this step's A rows have landed
+                # (the dl.wait/consume_token contract, ref :236-237).
+                prev.wait_recv()
+
+                @pl.when(s < n - 1)
+                def _():
+                    fwd_copy(chunk, s).start()
+
+    # --- consumer side: tiled matmul of this chunk against the B strip.
+    @pl.when(j == 0)
+    def _load_a():
+        cp = pltpu.make_async_copy(
+            ws_ref.at[pl.ds(chunk * m_loc + i * tm, tm)], a_tile, ld_sem
+        )
+        cp.start()
+        cp.wait()
+
+    acc[...] = jnp.dot(
+        a_tile[...], b_ref[...], preferred_element_type=jnp.float32
+    ).astype(out_dtype)
+    st = pltpu.make_async_copy(
+        acc,
+        c_ref.at[pl.ds(chunk * m_loc + i * tm, tm), pl.ds(j * tn, tn)],
+        st_sem,
+    )
+    st.start()
+    st.wait()
+
+
+def ag_gemm(
+    a_shard: jax.Array,
+    b: jax.Array,
+    axis: str = TP_AXIS,
+    config: Optional[AgGemmConfig] = None,
+    return_gathered: bool = False,
+):
+    """Overlapped AllGather(a_shard) @ b; per-device function inside shard_map
+    (ref host entry: allgather_gemm.py:534-575 `ag_gemm`).
+
+    a_shard: (M/n, K); b: (K, N_loc). Returns C (M, N_loc), and the gathered
+    A (M, K) when return_gathered.
+    """
+    cfg = config or AgGemmConfig()
+    n = jax.lax.axis_size(axis)
+    m_loc, k = a_shard.shape
+    k2, n_loc = b.shape
+    assert k == k2, f"K mismatch {k} vs {k2}"
+    tm = min(cfg.tile_m, m_loc)
+    tn = min(cfg.tile_n, n_loc)
+    if m_loc % tm or n_loc % tn:
+        raise ValueError(
+            f"shard dims ({m_loc},{n_loc}) must divide tiles ({tm},{tn})"
+        )
+
+    # VMEM residents: B strip (K, tn), A tile (tm, K), acc (tm, tn).
+    itemsize = jnp.dtype(a_shard.dtype).itemsize
+    vmem_need = k * tn * itemsize * 2 + tm * k * itemsize + tm * tn * 4
+    if vmem_need > cfg.vmem_budget:
+        # Fallback: XLA AG + dot (the reference's torch path analog).
+        a_full = jax.lax.all_gather(a_shard, axis, tiled=True)
+        c = jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
+            a_shard.dtype
+        )
+        return (c, a_full) if return_gathered else c
+
+    mt = cdiv(m_loc, tm)
+    nt = cdiv(n_loc, tn)
+    out_dtype = a_shard.dtype
+
+    grid = (n, mt, nt)
+    ws, c = tpu_call(
+        functools.partial(_ag_gemm_kernel, axis, n, tm, tn, out_dtype),
+        grid=grid,
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (k, tn), lambda s, i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tm, k), a_shard.dtype),
+            pltpu.VMEM((tm, tn), out_dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(f"ag_gemm_{axis}"),
+            vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
+        ),
+    )(a_shard, b)
+    return (c, ws) if return_gathered else c
+
+
+def ag_gemm_ref(a_shard: jax.Array, b: jax.Array, axis: str = TP_AXIS):
+    """Unfused XLA reference path (the reference's torch_fwd analog,
+    ref: layers/nvidia/tp_mlp.py torch_fwd)."""
+    a_full = jax.lax.all_gather(a_shard, axis, tiled=True)
+    return jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
+        a_shard.dtype
+    )

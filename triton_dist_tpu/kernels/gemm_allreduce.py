@@ -1,0 +1,61 @@
+"""GEMM+AllReduce — the low-latency decode-path matmul.
+
+TPU-native analog of the reference's fused GEMM+AR
+(ref: python/triton_dist/kernels/nvidia/gemm_allreduce.py:48-111
+`GemmARContext`/`gemm_allreduce_op`/`low_latency_gemm_allreduce_op`), used by
+the `gemm_ar` forward mode of TP layers (ref: layers/nvidia/tp_attn.py:297,
+e2e 1.26-1.35x wins in docs/getting-started/e2e/e2e_dense.md:34-38). The
+reference keeps a double-buffered symmetric phase counter so consecutive
+calls don't need a barrier; on TPU each fused call is one Pallas kernel
+whose semaphores are kernel-local, so re-entrancy is structural.
+
+Two regimes, as in the reference:
+  - low-latency (small M, decode): partial = a @ b on the MXU, then the
+    one-shot push AllReduce (n-1 direct puts) — minimum hop count.
+  - bandwidth (large M, prefill): gemm_rs ring (compute-overlapped) + ring
+    AG, the two-shot analog.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather import ring_all_gather
+from triton_dist_tpu.kernels.allreduce import one_shot_all_reduce
+from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs, GemmRsConfig
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+_LOW_LATENCY_MAX_ROWS = 256
+
+
+def gemm_ar(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = TP_AXIS,
+    config: Optional[GemmRsConfig] = None,
+) -> jax.Array:
+    """AllReduce(a @ b); per-device function inside shard_map.
+
+    a: (M, K_loc); b: (K_loc, N). Returns the replicated (M, N) sum over
+    the axis (ref op: gemm_allreduce.py:94-111).
+    """
+    n = jax.lax.axis_size(axis)
+    m = a.shape[0]
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    if m <= _LOW_LATENCY_MAX_ROWS or m % n:
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+            a.dtype
+        )
+        return one_shot_all_reduce(partial, axis)
+    scattered = gemm_rs(a, b, axis, config=config)
+    return ring_all_gather(scattered, axis)
+
+
+def gemm_ar_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:
+    """Unfused XLA reference path (torch AR analog)."""
+    partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jax.lax.psum(partial, axis)

@@ -1,0 +1,193 @@
+"""Fused GEMM+ReduceScatter.
+
+TPU-native re-design of the reference's GEMM+RS
+(ref: python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py:122-583):
+there, a producer GEMM counts finished tiles per M-segment and notifies a
+consumer reduce kernel on a separate stream (:232-248, :559-562). Here the
+producer and consumer fuse into ONE Pallas ring: the verified ring-RS
+protocol (see reduce_scatter.py) with the stage buffer *computed by the MXU*
+instead of loaded — each ring hop's transfer overlaps with the matmul of the
+next chunk's partial product.
+
+Computes: C_shard = ReduceScatter(a @ b)   [row-parallel TP matmul]
+  a: (M, K_loc) per device, b: (K_loc, N) per device -> C_shard: (M/n, N),
+  where rank r keeps sum_r' (a_r' @ b_r')[r*M/n:(r+1)*M/n].
+
+Chunk schedule (= ring RS): step s sends accumulated chunk (me-s-1) mod n,
+receives chunk (me-s-2) mod n, and contributes its own partial of that
+chunk, computed *while the hop is in flight*. The reference's tile-counter
++ notify (:232-234) becomes the per-parity DMA delivery semaphore; its
+dedicated rs_stream becomes the ring hop running concurrently with MXU work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import (
+    tpu_call,
+    compiler_params,
+    next_collective_id,
+    cdiv,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRsConfig:
+    tile_m: int = 128
+    vmem_budget: int = 14 << 20
+
+
+def _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, dst, ld_sem,
+                   out_dtype):
+    """dst[:] = a[chunk rows] @ b, tiled over M (b resident in VMEM)."""
+    mt = m_loc // tm
+    for i in range(mt):
+        cp = pltpu.make_async_copy(
+            a_ref.at[pl.ds(chunk * m_loc + i * tm, tm)], a_tile, ld_sem
+        )
+        cp.start()
+        cp.wait()
+        dst[pl.ds(i * tm, tm), :] = jnp.dot(
+            a_tile[...], b_ref[...], preferred_element_type=jnp.float32
+        ).astype(out_dtype)
+
+
+def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype,
+                    a_ref, b_ref, o_ref, acc, stage, a_tile,
+                    ld_sem, st_sem, send_sem, recv_sems, credit_sem):
+    me = jax.lax.axis_index(axis)
+    m_loc = o_ref.shape[0]
+    left = jnp.mod(me - 1, n)
+    right = jnp.mod(me + 1, n)
+
+    if n == 1:
+        _partial_chunk(a_ref, b_ref, 0, m_loc, tm, a_tile, acc.at[0], ld_sem,
+                       out_dtype)
+        st = pltpu.make_async_copy(acc.at[0], o_ref, st_sem)
+        st.start()
+        st.wait()
+        return
+
+    shmem.neighbor_barrier(axis, me, n)
+    # Step-0 incoming targets our slot 1 (free): grant left one credit
+    # (flow-control protocol of reduce_scatter._ring_rs_kernel).
+    pltpu.semaphore_signal(
+        credit_sem, inc=1, device_id={axis: left},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+
+    # Compute our partial of the first travelling chunk, (me-1) mod n.
+    first = jnp.mod(me - 1, n)
+    _partial_chunk(a_ref, b_ref, first, m_loc, tm, a_tile, acc.at[0], ld_sem,
+                   out_dtype)
+
+    for s in range(n - 1):
+        cur, nxt = s % 2, (s + 1) % 2
+        pltpu.semaphore_wait(credit_sem, 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc.at[cur],
+            dst_ref=acc.at[nxt],
+            send_sem=send_sem,
+            recv_sem=recv_sems.at[nxt],
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        # MXU fills the stage with our partial of the incoming chunk while
+        # the hop is in flight — this is the producer/consumer overlap.
+        chunk = jnp.mod(me - s - 2, n)
+        _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, stage, ld_sem,
+                       out_dtype)
+        rdma.wait_send()
+        if s + 1 <= n - 2:
+            pltpu.semaphore_signal(
+                credit_sem, inc=1, device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        rdma.wait_recv()
+        acc[nxt] = acc[nxt] + stage[...]
+
+    final = (n - 1) % 2
+    st = pltpu.make_async_copy(acc.at[final], o_ref, st_sem)
+    st.start()
+    st.wait()
+
+
+def gemm_rs(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = TP_AXIS,
+    config: Optional[GemmRsConfig] = None,
+) -> jax.Array:
+    """Overlapped ReduceScatter(a @ b); per-device function inside shard_map
+    (ref host entry: gemm_reduce_scatter.py:569-583 `gemm_rs`).
+
+    a: (M, K_loc); b: (K_loc, N). Returns rank's reduced chunk (M/n, N).
+    """
+    cfg = config or GemmRsConfig()
+    n = jax.lax.axis_size(axis)
+    m, k_loc = a.shape
+    k2, n_full = b.shape
+    assert k_loc == k2, f"K mismatch {k_loc} vs {k2}"
+    if m % n:
+        raise ValueError(f"M={m} not divisible by axis size {n}")
+    m_loc = m // n
+    tm = min(cfg.tile_m, m_loc)
+    if m_loc % tm:
+        raise ValueError(f"chunk rows {m_loc} must divide tile_m {tm}")
+
+    out_dtype = a.dtype
+    itemsize = jnp.dtype(out_dtype).itemsize
+    # VMEM residents: b (K_loc, N), acc 2x(m_loc, N), stage (m_loc, N),
+    # a tile (tm, K_loc).
+    vmem_need = (
+        k_loc * n_full * itemsize
+        + 3 * m_loc * n_full * itemsize
+        + tm * k_loc * itemsize
+    )
+    if vmem_need > cfg.vmem_budget:
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+            out_dtype
+        )
+        return jax.lax.psum_scatter(partial, axis, tiled=True)
+
+    return tpu_call(
+        functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype),
+        out_shape=jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, m_loc, n_full), out_dtype),
+            pltpu.VMEM((m_loc, n_full), out_dtype),
+            pltpu.VMEM((tm, k_loc), a.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(f"gemm_rs_{axis}"),
+            vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
+        ),
+    )(a, b)
+
+
+def gemm_rs_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:
+    """Unfused XLA reference path."""
+    partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jax.lax.psum_scatter(partial, axis, tiled=True)
