@@ -102,6 +102,29 @@ def test_reduce_scatter_values(mesh8):
     np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
 
 
+def test_reduce_scatter_bf16(mesh8):
+    """bf16 ring RS: accumulation happens in the input dtype by design
+    (see _ring_rs_kernel dtype contract) — verify within bf16 tolerance."""
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((8, 64, 128)).astype(np.float32)
+    ref = data.sum(0)
+
+    def fn(xs):
+        return reduce_scatter(
+            xs[0].astype(jnp.bfloat16), "tp", method=ReduceScatterMethod.Ring1D
+        )
+
+    y = jax.jit(
+        jax.shard_map(fn, mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+                      check_vma=False)
+    )(jnp.asarray(data))
+    # 7 bf16 adds of ~N(0,1) values: tolerance scaled to bf16's ~3 decimal
+    # digits over a sum of magnitude ~sqrt(8).
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), ref, rtol=0.05, atol=0.15
+    )
+
+
 @pytest.mark.parametrize(
     "method",
     [AllReduceMethod.OneShot, AllReduceMethod.TwoShot, AllReduceMethod.XLA],

@@ -16,16 +16,8 @@ Layer map (mirrors reference SURVEY.md table; reference = Triton-distributed):
                (ref: python/triton_dist/language/, libshmem_device)
   kernels/   - overlapping collective + compute kernels
                (ref: python/triton_dist/kernels/nvidia/)
-  layers/    - TP/SP/EP/PP parallel model layers
-               (ref: python/triton_dist/layers/nvidia/)
-  models/    - model configs, dense + MoE LLMs, KV cache, inference engine
-               (ref: python/triton_dist/models/)
-  megakernel/- single persistent-kernel task-graph scheduler
-               (ref: python/triton_dist/mega_triton_kernel/)
-  tools/     - contextual autotuner, AOT export, profiling tools
-               (ref: python/triton_dist/tools/, autotuner.py)
-  csrc/      - native C++ host components (tile swizzle, MoE align,
-               megakernel scheduler) bound via ctypes
+Subpackages under construction land here as they are built (layers/,
+models/, megakernel/, tools/, csrc/ in the reference's inventory).
 """
 
 __version__ = "0.1.0"

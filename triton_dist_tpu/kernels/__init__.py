@@ -42,3 +42,13 @@ from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
     gemm_ar,
     gemm_ar_ref,
 )
+from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
+    all_to_all,
+    fast_all_to_all,
+    all_to_all_ref,
+)
+from triton_dist_tpu.kernels.p2p import (  # noqa: F401
+    p2p_send,
+    p2p_read,
+    ring_shift,
+)

@@ -30,7 +30,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import shmem
-from triton_dist_tpu.lang.core import tpu_call, compiler_params, next_collective_id
+from triton_dist_tpu.lang.core import (
+    tpu_call,
+    compiler_params,
+    next_collective_id,
+    interpret_no_headroom,
+)
 from triton_dist_tpu.runtime.init import EP_AXIS
 
 
@@ -91,6 +96,10 @@ def all_to_all(
     n = jax.lax.axis_size(axis)
     if x.shape[0] != n:
         raise ValueError(f"x leading dim {x.shape[0]} != axis size {n}")
+    if n == 1:
+        return x, splits.astype(jnp.int32)
+    if interpret_no_headroom():
+        return all_to_all_ref(x, splits, axis)
     splits2d = splits.reshape(n, 1).astype(jnp.int32)
     out, out_splits = tpu_call(
         functools.partial(_a2a_kernel, axis, n),

@@ -32,7 +32,12 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.lang import shmem
-from triton_dist_tpu.lang.core import tpu_call, compiler_params, next_collective_id
+from triton_dist_tpu.lang.core import (
+    tpu_call,
+    compiler_params,
+    next_collective_id,
+    interpret_no_headroom,
+)
 from triton_dist_tpu.runtime.init import TP_AXIS
 
 
@@ -156,6 +161,10 @@ def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
 
 def ring_all_gather(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     """Ring AG of per-device shard `x` -> (n*m, ...). Call inside shard_map."""
+    if jax.lax.axis_size(axis) == 1:
+        return x
+    if interpret_no_headroom():
+        return jax.lax.all_gather(x, axis, tiled=True)
     return _pallas_ag(x, axis, _ring_ag_kernel, f"ring_ag_{axis}",
                       per_step_recv=True)
 
@@ -164,6 +173,10 @@ def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     """Full-mesh push AG (latency-optimal for small messages). All incoming
     puts target distinct slots and are only consumed after the full wait, so
     a single shared recv semaphore is exact here."""
+    if jax.lax.axis_size(axis) == 1:
+        return x
+    if interpret_no_headroom():
+        return jax.lax.all_gather(x, axis, tiled=True)
     return _pallas_ag(x, axis, _full_mesh_ag_kernel, f"fm_ag_{axis}",
                       per_step_recv=False)
 

@@ -37,6 +37,7 @@ from triton_dist_tpu.lang.core import (
     compiler_params,
     next_collective_id,
     cdiv,
+    interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
 
@@ -153,7 +154,7 @@ def ag_gemm(
     # VMEM residents: B strip (K, tn), A tile (tm, K), acc (tm, tn).
     itemsize = jnp.dtype(a_shard.dtype).itemsize
     vmem_need = k * tn * itemsize * 2 + tm * k * itemsize + tm * tn * 4
-    if vmem_need > cfg.vmem_budget:
+    if vmem_need > cfg.vmem_budget or interpret_no_headroom():
         # Fallback: XLA AG + dot (the reference's torch path analog).
         a_full = jax.lax.all_gather(a_shard, axis, tiled=True)
         c = jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
@@ -194,7 +195,12 @@ def ag_gemm(
         ],
         compiler_params=compiler_params(
             has_side_effects=True,
-            collective_id=next_collective_id(f"ag_gemm_{axis}"),
+            # The barrier semaphore (keyed by collective_id) is only used by
+            # the n>1 neighbor_barrier; Mosaic rejects a collective_id when
+            # no custom barrier exists in the kernel (world=1).
+            collective_id=(
+                next_collective_id(f"ag_gemm_{axis}") if n > 1 else None
+            ),
             vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
         ),
     )(a_shard, b)

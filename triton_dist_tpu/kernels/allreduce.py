@@ -31,6 +31,7 @@ from triton_dist_tpu.lang.core import (
     tpu_call,
     compiler_params,
     next_collective_id,
+    interpret_no_headroom,
 )
 from triton_dist_tpu.kernels.allgather import ring_all_gather
 from triton_dist_tpu.kernels.reduce_scatter import ring_reduce_scatter
@@ -45,6 +46,11 @@ class AllReduceMethod(enum.Enum):
 
 
 _ONE_SHOT_MAX_BYTES = 256 << 10  # latency-bound regime (ref :1101-1126)
+# One-shot materializes (n+1) tensor copies in VMEM; above this the kernel
+# cannot compile under Mosaic — fall back (the chunked-entry rationale of
+# ref allreduce.py:1129-1208). Same VMEM-resident budget convention as
+# AgGemmConfig/GemmRsConfig.
+_ONE_SHOT_VMEM_BUDGET = 14 << 20
 
 
 def choose_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
@@ -90,6 +96,11 @@ def _one_shot_ar_kernel(axis: str, n: int, x_ref, o_ref, ws, acc, ld_sem,
 def one_shot_all_reduce(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     """Latency-optimal AR of a per-device tensor. Call inside shard_map."""
     n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x
+    vmem_need = (n + 1) * x.size * x.dtype.itemsize
+    if vmem_need > _ONE_SHOT_VMEM_BUDGET or interpret_no_headroom():
+        return jax.lax.psum(x, axis)
     return tpu_call(
         functools.partial(_one_shot_ar_kernel, axis, n),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -105,6 +116,7 @@ def one_shot_all_reduce(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
         compiler_params=compiler_params(
             has_side_effects=True,
             collective_id=next_collective_id(f"one_shot_ar_{axis}"),
+            vmem_limit_bytes=vmem_need + (2 << 20),
         ),
     )(x)
 

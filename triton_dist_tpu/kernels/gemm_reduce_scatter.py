@@ -37,6 +37,7 @@ from triton_dist_tpu.lang.core import (
     compiler_params,
     next_collective_id,
     cdiv,
+    interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
 
@@ -155,7 +156,7 @@ def gemm_rs(
         + 3 * m_loc * n_full * itemsize
         + tm * k_loc * itemsize
     )
-    if vmem_need > cfg.vmem_budget:
+    if vmem_need > cfg.vmem_budget or interpret_no_headroom():
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
@@ -181,7 +182,11 @@ def gemm_rs(
         ],
         compiler_params=compiler_params(
             has_side_effects=True,
-            collective_id=next_collective_id(f"gemm_rs_{axis}"),
+            # barrier semaphore only exists in the n>1 kernel body (see
+            # neighbor_barrier); collective_id must be omitted at world=1.
+            collective_id=(
+                next_collective_id(f"gemm_rs_{axis}") if n > 1 else None
+            ),
             vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
         ),
     )(a, b)

@@ -23,7 +23,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import shmem
-from triton_dist_tpu.lang.core import tpu_call, compiler_params, next_collective_id
+from triton_dist_tpu.lang.core import (
+    tpu_call,
+    compiler_params,
+    next_collective_id,
+    interpret_no_headroom,
+)
 from triton_dist_tpu.runtime.init import PP_AXIS
 
 
@@ -38,14 +43,21 @@ def _p2p_kernel(axis: str, n: int, src_rank: int, dst_rank: int,
     # land while dst is still in a previous kernel using these semaphores.
     shmem.barrier_all(axis)
 
-    # Default: local identity copy (ranks not involved keep their buffer,
-    # and dst's local value is overwritten by the incoming put below).
-    cp = pltpu.make_async_copy(x_ref, o_ref, cp_sem)
-    cp.start()
-    cp.wait()
-
     if src_rank == dst_rank or n == 1:
+        cp = pltpu.make_async_copy(x_ref, o_ref, cp_sem)
+        cp.start()
+        cp.wait()
         return
+
+    # Local identity copy for every rank EXCEPT dst: dst's output is written
+    # only by the incoming put. Nothing orders a local copy against the
+    # remote DMA's arrival, so dst writing o_ref itself would race the put
+    # (the put could land first and be overwritten after wait_recv).
+    @pl.when(me != dst_rank)
+    def _():
+        cp = pltpu.make_async_copy(x_ref, o_ref, cp_sem)
+        cp.start()
+        cp.wait()
 
     rdma = pltpu.make_async_remote_copy(
         src_ref=x_ref,
@@ -73,6 +85,12 @@ def p2p_send(x: jax.Array, src_rank: int, dst_rank: int,
     (matched collective), mirroring the reference's symmetric-buffer p2p
     contract (ref: kernels/nvidia/p2p.py:31-54)."""
     n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x
+    if interpret_no_headroom():
+        me = jax.lax.axis_index(axis)
+        shifted = jax.lax.ppermute(x, axis, [(src_rank, dst_rank)])
+        return jnp.where(me == dst_rank, shifted, x)
     return tpu_call(
         functools.partial(_p2p_kernel, axis, n, src_rank, dst_rank),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -103,6 +121,9 @@ def ring_shift(x: jax.Array, shift: int = 1, axis: str = PP_AXIS) -> jax.Array:
     n = jax.lax.axis_size(axis)
     if n == 1:
         return x
+    if interpret_no_headroom():
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
 
     def kernel(x_ref, o_ref, send_sem, recv_sem):
         me = jax.lax.axis_index(axis)

@@ -27,6 +27,7 @@ from triton_dist_tpu.lang.core import (
     compiler_params,
     next_collective_id,
     compute_vmem_bytes,
+    interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
 
@@ -62,6 +63,15 @@ def _ring_rs_kernel(axis: str, n: int, x_ref, o_ref, acc, stage, ld_sem, st_sem,
     we take one credit before each send. Credits cap outstanding incoming
     puts at 2, which always target opposite-parity slots, so the
     parity-indexed recv semaphores make every wait exact.
+
+    Dtype contract: accumulation happens in the INPUT dtype (acc/stage are
+    x.dtype) — bf16 inputs take n-1 bf16 additions around the ring. This is
+    deliberate: an f32 accumulator would double the wire bytes of every hop
+    (the accumulator IS the RDMA payload), trading the ring's bandwidth
+    optimality for precision the ≤8-rank inference workloads don't need.
+    Callers needing f32 accumulation use ReduceScatterMethod.XLA (psum
+    semantics) or upcast before the call; the fused GEMM paths accumulate
+    their matmuls in f32 via preferred_element_type regardless.
     """
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
@@ -120,6 +130,10 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     n = jax.lax.axis_size(axis)
     if x.shape[0] % n != 0:
         raise ValueError(f"leading dim {x.shape[0]} not divisible by {n}")
+    if n == 1:
+        return x
+    if interpret_no_headroom():
+        return jax.lax.psum_scatter(x, axis, tiled=True)
     m = x.shape[0] // n
     chunk_shape = (m,) + x.shape[1:]
     return tpu_call(

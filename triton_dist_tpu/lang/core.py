@@ -39,6 +39,45 @@ def tpu_call(kernel, **kwargs):
     return pl.pallas_call(kernel, **kwargs)
 
 
+def interpret_no_headroom() -> bool:
+    """True when interpret-mode Pallas kernels that block across devices
+    must not be used because the host has no spare executor threads.
+
+    XLA:CPU sizes its thunk-executor pool by the virtual device count, and
+    interpret-mode kernels block pool threads inside callbacks (semaphore
+    waits; operand materialization). When the surrounding mesh occupies
+    every virtual device, those blocked callbacks exhaust the pool, pending
+    compute starves, and cross-device-blocking kernels deadlock. Kernels
+    consult this to route to their XLA-collective fallback instead — the
+    result is identical, only the overlap protocol is skipped. This is what
+    keeps `__graft_entry__.dryrun_multichip` (driver sets device count ==
+    mesh size) deadlock-free while the test suite (12 virtual devices,
+    8-device meshes) still exercises the real protocols.
+    """
+    if not use_interpret():
+        return False
+    try:
+        from jax._src import mesh as mesh_lib
+
+        m = mesh_lib.get_abstract_mesh()
+        if m is not None and m.shape:
+            import math
+
+            mesh_total = math.prod(m.shape.values())
+            return mesh_total >= len(jax.devices())
+    except Exception as e:  # private API moved: warn, stay safe
+        import warnings
+
+        warnings.warn(
+            f"interpret_no_headroom: cannot inspect the abstract mesh ({e}); "
+            "assuming no headroom and routing to XLA fallbacks"
+        )
+    # Unknown mesh under interpret mode: the safe default is the
+    # non-blocking XLA path (a wrong False here deadlocks; a wrong True
+    # only skips the overlap protocol).
+    return True
+
+
 def cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
 
