@@ -1,0 +1,117 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Workload: the per-TP-rank Qwen3-32B MLP block at M=2048 — the reference's
+headline e2e microbench (ref: docs/getting-started/e2e/e2e_dense.md:21,
+0.8854 ms for the full 8-rank AG+GEMM/GEMM+RS pipeline on 8x H800).
+On this machine one real TPU chip is available, so the measured quantity is
+the world=1 fused pipeline: ag_gemm(gate/up) -> silu*mul -> gemm_rs(down)
+at the per-rank shard shapes (hidden=5120, intermediate=25600, TP=8:
+N_loc=3200 per projection), bf16, f32 accumulation.
+
+vs_baseline = measured_ms / 0.8854 (the 8-rank H800 pipeline number; <1.0
+would mean beating the reference's full-pipeline latency with one chip's
+compute - not expected; the ratio tracks progress as overlap + multi-chip
+land).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels import (
+    ag_gemm,
+    AgGemmConfig,
+    gemm_rs,
+    GemmRsConfig,
+)
+from triton_dist_tpu.runtime import make_mesh
+
+_BASELINE_MS = 0.8854  # ref e2e_dense.md:21, TP MLP M=2048, 8x H800
+
+M = 2048
+HIDDEN = 5120
+INTER = 25600
+TP = 8  # baseline TP degree; per-rank shard sizes below
+N_GATE_UP = 2 * INTER // TP  # fused gate+up projection, per rank
+K_DOWN = INTER // TP
+
+
+def mlp_block(x, w_gate_up, w_down):
+    """Per-rank TP MLP: column-parallel gate/up then row-parallel down
+    (ref: layers/nvidia/tp_mlp.py:52-276 dist_triton_fwd)."""
+    h = ag_gemm(x, w_gate_up, axis="tp", config=AgGemmConfig())
+    gate, up = jnp.split(h, 2, axis=-1)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+    return gemm_rs(act, w_down, axis="tp", config=GemmRsConfig())
+
+
+def _chained(mesh, world, k):
+    """k dependent MLP iterations inside one jit + scalar fetch.
+
+    The TPU here sits behind a network tunnel whose round trip (~90 ms)
+    dwarfs kernel time and whose block_until_ready returns early, so
+    wall-clocking one dispatch is meaningless. Chaining k data-dependent
+    iterations and differencing two chain lengths cancels both the RTT and
+    the fetch, leaving pure device time per iteration."""
+
+    def per_rank(x, w1, w2):
+        def body(_, c):
+            return mlp_block(c, w1, w2)
+
+        out = jax.lax.fori_loop(0, k, body, x)
+        return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+    return jax.jit(
+        jax.shard_map(
+            per_rank,
+            mesh=mesh,
+            in_specs=(P("tp"), P(None, "tp"), P("tp", None)),
+            out_specs=P("tp"),
+            check_vma=False,
+        )
+    )
+
+
+def main():
+    n = len(jax.devices())
+    world = min(n, TP)
+    mesh = make_mesh(mesh_shape=(world,), axis_names=("tp",))
+
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16
+    x = jnp.asarray(rng.standard_normal((M, HIDDEN)) * 0.02, dt)
+    w1 = jnp.asarray(rng.standard_normal((HIDDEN, N_GATE_UP * world)) * 0.02, dt)
+    w2 = jnp.asarray(rng.standard_normal((K_DOWN * world, HIDDEN)) * 0.02, dt)
+
+    k_lo, k_hi = 1, 21
+    f_lo, f_hi = _chained(mesh, world, k_lo), _chained(mesh, world, k_hi)
+    np.asarray(f_lo(x, w1, w2))  # compile + warm
+    np.asarray(f_hi(x, w1, w2))
+
+    def timed(f, reps=5):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(f(x, w1, w2))  # host fetch forces completion
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(ts))
+
+    ms = max(timed(f_hi) - timed(f_lo), 0.0) / (k_hi - k_lo)
+    print(
+        json.dumps(
+            {
+                "metric": "tp_mlp_m2048_ms",
+                "value": round(ms, 4),
+                "unit": "ms",
+                "vs_baseline": round(ms / _BASELINE_MS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

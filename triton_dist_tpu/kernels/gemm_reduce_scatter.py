@@ -140,6 +140,11 @@ def gemm_rs(
     m, k_loc = a.shape
     k2, n_full = b.shape
     assert k_loc == k2, f"K mismatch {k_loc} vs {k2}"
+    if n == 1:
+        # Nothing to scatter at world=1; XLA's matmul wins (see ag_gemm).
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+            a.dtype
+        )
     if m % n:
         raise ValueError(f"M={m} not divisible by axis size {n}")
     m_loc = m // n
