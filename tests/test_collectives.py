@@ -158,3 +158,24 @@ def test_all_reduce_auto_small(mesh8):
                       check_vma=False)
     )(jnp.asarray(data))
     np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_path_actually_taken(mesh8):
+    """Guard against silent fallback vacuousness: under the 12-device test
+    env an 8-mesh collective MUST trace real Pallas kernels, so a
+    regression in interpret_no_headroom() fails CI instead of silently
+    comparing XLA against XLA (round-2 ADVICE: lang/core.py fail-open)."""
+    from triton_dist_tpu.lang.core import interpret_no_headroom, pallas_call_count
+
+    before = pallas_call_count()
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8 * 8, 128)
+
+    def fn(xs):
+        assert not interpret_no_headroom()
+        return all_gather(xs, "tp", method=AllGatherMethod.Ring1D)
+
+    y = _shard_run(mesh8, fn, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    assert pallas_call_count() > before, (
+        "collective kernel was silently rerouted to the XLA fallback"
+    )

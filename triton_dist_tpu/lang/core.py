@@ -32,8 +32,21 @@ def use_interpret() -> bool:
     return _FORCE_INTERPRET or backend_platform() != "tpu"
 
 
+# Counts every Pallas kernel constructed through tpu_call. Lets tests and
+# the driver dryrun assert the real protocol kernels were traced rather
+# than silently rerouted to XLA fallbacks (a fail-open here previously made
+# the whole fused-vs-ref suite vacuous).
+_PALLAS_CALLS = 0
+
+
+def pallas_call_count() -> int:
+    return _PALLAS_CALLS
+
+
 def tpu_call(kernel, **kwargs):
     """pl.pallas_call with automatic interpret-mode fallback off-TPU."""
+    global _PALLAS_CALLS
+    _PALLAS_CALLS += 1
     if use_interpret() and "interpret" not in kwargs:
         kwargs["interpret"] = pltpu.InterpretParams()
     return pl.pallas_call(kernel, **kwargs)
@@ -56,22 +69,12 @@ def interpret_no_headroom() -> bool:
     """
     if not use_interpret():
         return False
-    try:
-        from jax._src import mesh as mesh_lib
+    m = jax.sharding.get_abstract_mesh()
+    if m is not None and m.shape:
+        import math
 
-        m = mesh_lib.get_abstract_mesh()
-        if m is not None and m.shape:
-            import math
-
-            mesh_total = math.prod(m.shape.values())
-            return mesh_total >= len(jax.devices())
-    except Exception as e:  # private API moved: warn, stay safe
-        import warnings
-
-        warnings.warn(
-            f"interpret_no_headroom: cannot inspect the abstract mesh ({e}); "
-            "assuming no headroom and routing to XLA fallbacks"
-        )
+        mesh_total = math.prod(m.shape.values())
+        return mesh_total >= len(jax.devices())
     # Unknown mesh under interpret mode: the safe default is the
     # non-blocking XLA path (a wrong False here deadlocks; a wrong True
     # only skips the overlap protocol).
