@@ -129,21 +129,25 @@ def gemm_rs(
     b: jax.Array,
     axis: str = TP_AXIS,
     config: Optional[GemmRsConfig] = None,
+    out_dtype=None,
+    force_kernel: bool = False,
 ) -> jax.Array:
     """Overlapped ReduceScatter(a @ b); per-device function inside shard_map
     (ref host entry: gemm_reduce_scatter.py:569-583 `gemm_rs`).
 
     a: (M, K_loc); b: (K_loc, N). Returns rank's reduced chunk (M/n, N).
+    out_dtype also sets the cross-rank accumulation dtype in the ring.
     """
     cfg = config or GemmRsConfig()
+    out_dtype = out_dtype or a.dtype
     n = jax.lax.axis_size(axis)
     m, k_loc = a.shape
     k2, n_full = b.shape
     assert k_loc == k2, f"K mismatch {k_loc} vs {k2}"
-    if n == 1:
+    if n == 1 and not force_kernel:
         # Nothing to scatter at world=1; XLA's matmul wins (see ag_gemm).
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
-            a.dtype
+            out_dtype
         )
     if m % n:
         raise ValueError(f"M={m} not divisible by axis size {n}")
@@ -151,17 +155,18 @@ def gemm_rs(
     tm = min(cfg.tile_m, m_loc)
     if m_loc % tm:
         raise ValueError(f"chunk rows {m_loc} must divide tile_m {tm}")
-
-    out_dtype = a.dtype
-    itemsize = jnp.dtype(out_dtype).itemsize
-    # VMEM residents: b (K_loc, N), acc 2x(m_loc, N), stage (m_loc, N),
-    # a tile (tm, K_loc).
+    in_itemsize = jnp.dtype(a.dtype).itemsize
+    out_itemsize = jnp.dtype(out_dtype).itemsize
+    # VMEM residents: b (K_loc, N) and a tile (tm, K_loc) in the input
+    # dtype; acc 2x(m_loc, N) + stage (m_loc, N) in the accumulation dtype.
     vmem_need = (
-        k_loc * n_full * itemsize
-        + 3 * m_loc * n_full * itemsize
-        + tm * k_loc * itemsize
+        k_loc * n_full * in_itemsize
+        + 3 * m_loc * n_full * out_itemsize
+        + tm * k_loc * in_itemsize
     )
-    if vmem_need > cfg.vmem_budget or interpret_no_headroom():
+    if (vmem_need > cfg.vmem_budget or interpret_no_headroom()) and (
+        not force_kernel
+    ):
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
             out_dtype
         )

@@ -1,0 +1,81 @@
+"""Attention cores: GQA prefill (causal) and single-step decode.
+
+TPU-native analog of the reference's attention calls inside TP_Attn
+(ref: python/triton_dist/layers/nvidia/tp_attn.py:180-253, which calls
+flashinfer prefill/decode kernels). Here the cores are XLA einsum chains —
+on TPU, XLA emits a fused flash-style attention for these patterns and the
+MXU does the work; Pallas enters for the *distributed* variants
+(sp_attention.py, flash_decode.py) where per-segment semaphore waits are
+the point.
+
+Shapes (GQA): q (B, S, Hq, D), k/v (B, T, Hkv, D), Hq = G * Hkv.
+All softmax math in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gqa_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    kv_len: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+):
+    """Grouped-query attention forward.
+
+    q_offset: absolute position of q row 0 within the KV timeline (decode:
+    cache length). kv_len: optional valid KV prefix length (masks the
+    preallocated cache tail). Returns (B, S, Hq, D) in q.dtype.
+    """
+    b, s, hq, d = q.shape
+    _, t, hkv, _ = k.shape
+    g = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    qg = qf.reshape(b, s, hkv, g, d)
+
+    # logits: (B, Hkv, G, S, T)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, kf)
+
+    mask = None
+    if causal:
+        qpos = jnp.arange(s)[:, None] + q_offset  # (S, 1)
+        kpos = jnp.arange(t)[None, :]
+        mask = kpos <= qpos  # (S, T)
+    if kv_len is not None:
+        valid = jnp.arange(t)[None, :] < jnp.reshape(kv_len, (-1, 1))  # (B, T)
+        valid = valid[:, None, None, None, :]
+        mask = valid if mask is None else jnp.logical_and(mask, valid)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    # Numerically-safe softmax (rows fully masked yield zeros, not NaN).
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - jnp.maximum(m, NEG_INF / 2))
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+
+    out = jnp.einsum("bkgst,btkd->bskgd", p, vf)
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
+def gqa_decode(q, k_cache, v_cache, kv_len, scale: Optional[float] = None):
+    """One-token decode against a preallocated KV cache.
+
+    q: (B, 1, Hq, D); k_cache/v_cache: (B, T_max, Hkv, D); kv_len: (B,)
+    number of valid entries (including the token written this step).
+    """
+    return gqa_attention(
+        q, k_cache, v_cache, causal=False, kv_len=kv_len, scale=scale
+    )

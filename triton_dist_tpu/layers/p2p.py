@@ -1,0 +1,103 @@
+"""Pipeline-parallel communication layer (CommOp analog).
+
+TPU-native re-design of the reference's PP CommOp
+(ref: python/triton_dist/layers/nvidia/p2p.py:43-140): there, a stage reads
+the previous stage's activation from a symmetric buffer after a
+cuStreamWaitValue on a signal word. On TPU the p2p transport is the Pallas
+remote-DMA p2p kernel (kernels/p2p.py) — the signal word is the DMA
+delivery semaphore, so `wait_signal` is implicit in the transfer — and the
+stage schedule is expressed as ordinary dataflow within one jit.
+
+Used inside shard_map over a `pp` mesh axis. Every rank executes the same
+program (SPMD), so `send_forward` moves every stage's activation to its
+right neighbor in one ring step; stage-dependent compute is selected with
+`jnp.where`/`lax.switch` on the stage index — compiler-friendly control
+flow instead of per-rank programs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.p2p import ring_shift
+from triton_dist_tpu.runtime.init import PP_AXIS
+
+
+class PPCommOp(NamedTuple):
+    """Static pipeline geometry (ref CommOp ctor, layers/nvidia/p2p.py:43)."""
+
+    axis: str = PP_AXIS
+
+    def stage(self):
+        return jax.lax.axis_index(self.axis)
+
+    def n_stages(self):
+        return jax.lax.axis_size(self.axis)
+
+    def send_forward(self, x):
+        """Move activations one stage forward (stage i -> i+1 ring shift).
+        The reference's read + signal pair (p2p.py:85-140) collapses into
+        the remote DMA + its delivery semaphore."""
+        return ring_shift(x, shift=1, axis=self.axis)
+
+    def send_backward(self, x):
+        """Move gradients one stage backward (i -> i-1)."""
+        return ring_shift(x, shift=-1, axis=self.axis)
+
+    def is_first(self):
+        return self.stage() == 0
+
+    def is_last(self):
+        return self.stage() == self.n_stages() - 1
+
+
+def pp_schedule_fwd(comm: PPCommOp, stage_fn, x, n_microbatches: int):
+    """GPipe-style forward schedule over microbatches inside one jit.
+
+    x: (n_microbatches, mb, ...) input at stage 0 (other stages ignore
+    their copy). Runs n_microbatches + n_stages - 1 ticks; each tick every
+    stage applies its stage_fn to the activation it holds, then passes it
+    forward. Returns the last stage's outputs (n_microbatches, mb, ...).
+
+    stage_fn: (stage_idx, activation) -> activation, same shape/dtype.
+    """
+    n_stages = jax.lax.axis_size(comm.axis)
+    stage = jax.lax.axis_index(comm.axis)
+    ticks = n_microbatches + n_stages - 1
+    mb_shape = x.shape[1:]
+
+    def tick(carry, t):
+        inflight, outputs = carry
+        # Stage 0 injects microbatch t (when in range); others use the
+        # activation that just arrived.
+        inject = jnp.where(t < n_microbatches, t, 0)
+        fed = jnp.where(stage == 0, x[inject], inflight)
+        # A stage holds valid data at tick t iff stage <= t.
+        act = stage_fn(stage, fed)
+        # Last stage records its finished microbatch (index t - stage).
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+        record = jnp.logical_and(stage == n_stages - 1,
+                                 t >= n_stages - 1)
+        outputs = jax.lax.cond(
+            record,
+            lambda o: o.at[out_idx].set(act),
+            lambda o: o,
+            outputs,
+        )
+        nxt = comm.send_forward(act)
+        return (nxt, outputs), None
+
+    outputs0 = jnp.zeros((n_microbatches,) + mb_shape, x.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (jnp.zeros(mb_shape, x.dtype), outputs0),
+        jnp.arange(ticks),
+    )
+    # Only the last stage holds real outputs; broadcast so every rank
+    # returns the same (replicated) result.
+    return jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        comm.axis,
+    )

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (half-split convention, Llama/Qwen family).
+
+TPU-native analog of the reference's rope application inside TP_Attn
+(ref: python/triton_dist/layers/nvidia/tp_attn.py:180-253, which calls
+flashinfer `apply_rope`). The table is precomputed once in f32 on host and
+indexed by position ids inside jit — no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(head_dim: int, max_positions: int, theta: float = 1_000_000.0):
+    """(cos, sin) tables of shape (max_positions, head_dim // 2), f32.
+
+    theta defaults to 1e6 (Qwen3's rope_theta).
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    pos = jnp.arange(max_positions, dtype=jnp.float32)
+    ang = jnp.outer(pos, inv_freq)  # (P, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin, positions):
+    """Rotate x: (..., S, H, D) by per-position angles.
+
+    positions: (..., S) int32 — gathered into the precomputed table, so
+    prefill (arange) and decode (cache length) share one code path.
+    Half-split convention: (x1, x2) -> (x1*cos - x2*sin, x2*cos + x1*sin).
+    """
+    half = x.shape[-1] // 2
+    c = cos[positions][..., None, :]  # (..., S, 1, half)
+    s = sin[positions][..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

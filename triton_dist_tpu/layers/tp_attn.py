@@ -1,0 +1,164 @@
+"""TP attention layer — column-parallel QKV, row-parallel O, GQA + RoPE.
+
+TPU-native re-design of the reference's TP_Attn
+(ref: python/triton_dist/layers/nvidia/tp_attn.py:79-330): torch_fwd :180,
+dist_triton_fwd :215 (ag_gemm QKV -> rope + flash attn -> gemm_rs O),
+AR modes :254-330. Heads shard over the tp axis (Hq/n query heads and
+Hkv/n kv heads per rank); the sequence-sharded residual stream is gathered
+by the fused AG+GEMM exactly as in the reference.
+
+Qwen3 specifics carried here: per-head q/k RMSNorm ("qk norm") before rope
+(Qwen3 applies it over head_dim), rope_theta 1e6.
+
+Per-rank weight layout:
+  w_qkv (hidden, (Hq + 2*Hkv)/n * D)  — q then k then v column blocks
+  w_o   (Hq/n * D, hidden)
+  q_norm, k_norm (D,) — per-head rmsnorm weights (optional, Qwen3)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels import (
+    AgGemmConfig,
+    GemmRsConfig,
+    ag_gemm,
+    gemm_ar,
+    gemm_rs,
+)
+from triton_dist_tpu.layers.attention import gqa_attention, gqa_decode
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.layers.rope import apply_rope
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+class TPAttnParams(NamedTuple):
+    w_qkv: jax.Array
+    w_o: jax.Array
+    q_norm: Optional[jax.Array] = None
+    k_norm: Optional[jax.Array] = None
+
+
+class TPAttnSpec(NamedTuple):
+    """Static per-rank head geometry."""
+
+    num_q_heads: int  # per rank
+    num_kv_heads: int  # per rank
+    head_dim: int
+
+
+def _split_qkv(h, spec: TPAttnSpec, batch: int):
+    """(M, (Hq+2Hkv)*D) -> q (B, S, Hq, D), k/v (B, S, Hkv, D)."""
+    m = h.shape[0]
+    s = m // batch
+    hq, hkv, d = spec.num_q_heads, spec.num_kv_heads, spec.head_dim
+    q, k, v = jnp.split(h, [hq * d, (hq + hkv) * d], axis=-1)
+    return (
+        q.reshape(batch, s, hq, d),
+        k.reshape(batch, s, hkv, d),
+        v.reshape(batch, s, hkv, d),
+    )
+
+
+def _qk_norm_rope(q, k, params: TPAttnParams, cos, sin, positions):
+    if params.q_norm is not None:
+        q = rms_norm(q, params.q_norm)
+    if params.k_norm is not None:
+        k = rms_norm(k, params.k_norm)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    return q, k
+
+
+def _attn_core(qkv, params, spec, batch, cos, sin, positions, kv_cache,
+               kv_len):
+    """Shared middle: split + qknorm + rope + (cached) attention.
+
+    Returns (attn_out (M, Hq*D), new_kv_cache)."""
+    q, k, v = _split_qkv(qkv, spec, batch)
+    q, k = _qk_norm_rope(q, k, params, cos, sin, positions)
+    if kv_cache is None:
+        out = gqa_attention(q, k, v, causal=True)
+        new_cache = (k, v)
+    else:
+        assert kv_len is not None, (
+            "kv_cache without kv_len would attend over the uninitialized "
+            "cache tail"
+        )
+        k_cache, v_cache = kv_cache
+        # Write this step's K/V into the cache at `positions`.
+        k_cache = _scatter_kv(k_cache, k, positions)
+        v_cache = _scatter_kv(v_cache, v, positions)
+        out = gqa_decode(q, k_cache, v_cache, kv_len)
+        new_cache = (k_cache, v_cache)
+    m = out.shape[0] * out.shape[1]
+    return out.reshape(m, spec.num_q_heads * spec.head_dim), new_cache
+
+
+def _scatter_kv(cache, kv, positions):
+    """cache (B, T, H, D) <- kv (B, S, H, D) at positions (B, S)."""
+    bidx = jnp.arange(cache.shape[0])[:, None]
+    return cache.at[bidx, positions].set(kv.astype(cache.dtype))
+
+
+def tp_attn_xla_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
+                    cos, sin, positions, batch: int, axis: str = TP_AXIS,
+                    kv_cache=None, kv_len=None):
+    """Unfused parity path (ref torch_fwd, tp_attn.py:180)."""
+    x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
+    qkv = jnp.dot(x_full, params.w_qkv,
+                  preferred_element_type=jnp.float32).astype(x_shard.dtype)
+    out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
+                                positions, kv_cache, kv_len)
+    partial = jnp.dot(out, params.w_o, preferred_element_type=jnp.float32)
+    y = jax.lax.psum_scatter(
+        partial.astype(x_shard.dtype), axis, tiled=True
+    )
+    return y, new_cache
+
+
+def tp_attn_dist_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
+                     cos, sin, positions, batch: int, axis: str = TP_AXIS,
+                     kv_cache=None, kv_len=None,
+                     ag_config: Optional[AgGemmConfig] = None,
+                     rs_config: Optional[GemmRsConfig] = None):
+    """Fused path (ref dist_triton_fwd, tp_attn.py:215): overlapped
+    AG+GEMM QKV projection, attention, overlapped GEMM+RS O projection.
+    x_shard: (M/n, hidden) -> ((M/n, hidden), new_kv_cache)."""
+    qkv = ag_gemm(x_shard, params.w_qkv, axis=axis, config=ag_config)
+    out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
+                                positions, kv_cache, kv_len)
+    y = gemm_rs(out, params.w_o, axis=axis, config=rs_config)
+    return y, new_cache
+
+
+def tp_attn_ar_fwd(x_full, params: TPAttnParams, spec: TPAttnSpec,
+                   cos, sin, positions, batch: int, axis: str = TP_AXIS,
+                   kv_cache=None, kv_len=None,
+                   rs_config: Optional[GemmRsConfig] = None):
+    """Replicated-activation path (ref AR fwd modes, tp_attn.py:254-330):
+    local QKV gemm, attention, fused gemm+allreduce O projection."""
+    qkv = jnp.dot(x_full, params.w_qkv,
+                  preferred_element_type=jnp.float32).astype(x_full.dtype)
+    out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
+                                positions, kv_cache, kv_len)
+    y = gemm_ar(out, params.w_o, axis=axis, config=rs_config)
+    return y, new_cache
+
+
+MODES = {
+    "xla": tp_attn_xla_fwd,
+    "dist": tp_attn_dist_fwd,
+    "ar": tp_attn_ar_fwd,
+}
+
+
+def tp_attn_fwd(x, params, spec, cos, sin, positions, batch,
+                axis: str = TP_AXIS, mode: str = "dist", **kw):
+    """Mode-switched forward (ref: models/dense.py:84-98 set_fwd)."""
+    return MODES[mode](x, params, spec, cos, sin, positions, batch,
+                       axis=axis, **kw)

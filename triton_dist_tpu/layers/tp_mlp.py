@@ -1,0 +1,106 @@
+"""TP MLP layer — column-parallel gate/up, row-parallel down.
+
+TPU-native re-design of the reference's TP_MLP
+(ref: python/triton_dist/layers/nvidia/tp_mlp.py:52-276). The reference
+carries three forward modes (torch_fwd :107, dist_triton_fwd :147 via
+ag_gemm/gemm_rs, AR modes :180-276 via gemm+allreduce); here the same
+three modes are per-device functions meant for use inside `jax.shard_map`:
+
+  xla_fwd  — unfused XLA collectives (the torch_fwd parity reference)
+  dist_fwd — fused ag_gemm -> silu*up -> gemm_rs (sequence-sharded M)
+  ar_fwd   — replicated input, local gemm + gemm_ar (decode/low-latency)
+
+Weight layout per rank: w_gate_up (hidden, 2*I/n) with gate in the first
+half of the columns, w_down (I/n, hidden).
+
+Perf note: dist_fwd keeps the gate/up activations in f32 between the two
+matmuls (out_dtype=f32 on ag_gemm, single cast after silu*up). Measured on
+v5e at the Qwen3-32B MLP shapes this is ~193 TF/s vs ~180 TF/s for the
+cast-early formulation — the bf16 round-trip breaks XLA's epilogue fusion.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels import (
+    AgGemmConfig,
+    GemmRsConfig,
+    ag_gemm,
+    gemm_ar,
+    gemm_rs,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+class TPMLPParams(NamedTuple):
+    """Per-rank shards: w_gate_up (hidden, 2*I/n), w_down (I/n, hidden)."""
+
+    w_gate_up: jax.Array
+    w_down: jax.Array
+
+
+def _silu_mul(h):
+    """silu(gate) * up on a fused (.., 2*I) activation, f32 math."""
+    gate, up = jnp.split(h.astype(jnp.float32), 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def tp_mlp_xla_fwd(x_shard, params: TPMLPParams, axis: str = TP_AXIS):
+    """Unfused parity path (ref torch_fwd, tp_mlp.py:107): AG + dot +
+    psum_scatter. x_shard: (M/n, hidden) -> (M/n, hidden)."""
+    x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
+    h = jnp.dot(x_full, params.w_gate_up, preferred_element_type=jnp.float32)
+    act = _silu_mul(h).astype(x_shard.dtype)
+    partial = jnp.dot(act, params.w_down, preferred_element_type=jnp.float32)
+    return jax.lax.psum_scatter(
+        partial.astype(x_shard.dtype), axis, tiled=True
+    )
+
+
+def tp_mlp_dist_fwd(
+    x_shard,
+    params: TPMLPParams,
+    axis: str = TP_AXIS,
+    ag_config: Optional[AgGemmConfig] = None,
+    rs_config: Optional[GemmRsConfig] = None,
+):
+    """Fused path (ref dist_triton_fwd, tp_mlp.py:147): overlapped
+    AG+GEMM then GEMM+RS. x_shard: (M/n, hidden) -> (M/n, hidden)."""
+    h = ag_gemm(
+        x_shard, params.w_gate_up, axis=axis, config=ag_config,
+        out_dtype=jnp.float32,
+    )
+    act = _silu_mul(h).astype(x_shard.dtype)
+    return gemm_rs(act, params.w_down, axis=axis, config=rs_config)
+
+
+def tp_mlp_ar_fwd(
+    x_full,
+    params: TPMLPParams,
+    axis: str = TP_AXIS,
+    rs_config: Optional[GemmRsConfig] = None,
+):
+    """Replicated-activation path (ref dist_triton_AR/gemm_ar fwd,
+    tp_mlp.py:180-276): local gate/up gemm + fused gemm+allreduce down.
+    x_full: (M, hidden) replicated -> (M, hidden) replicated."""
+    h = jnp.dot(x_full, params.w_gate_up, preferred_element_type=jnp.float32)
+    act = _silu_mul(h).astype(x_full.dtype)
+    return gemm_ar(act, params.w_down, axis=axis, config=rs_config)
+
+
+MODES = {
+    "xla": tp_mlp_xla_fwd,
+    "dist": tp_mlp_dist_fwd,
+    "ar": tp_mlp_ar_fwd,
+}
+
+
+def tp_mlp_fwd(x, params: TPMLPParams, axis: str = TP_AXIS,
+               mode: str = "dist", **kw):
+    """Mode-switched forward (the reference's set_fwd switch,
+    ref: models/dense.py:84-98)."""
+    return MODES[mode](x, params, axis=axis, **kw)
