@@ -76,7 +76,7 @@ def _chain_timer(build_fn, args, k_lo=1, k_hi=101, pairs=9, warmup=2):
     }
 
 
-def bench_mlp(mesh, world, x, w1, w2):
+def bench_mlp(mesh, x, w1, w2):
     def build(k):
         def per_rank(x, w1, w2):
             params = TPMLPParams(w1, w2)
@@ -149,7 +149,7 @@ def main():
     last_err = None
     for _ in range(3):  # transient tunnel glitches: retry the measurement
         try:
-            ms, raw = bench_mlp(mesh, world, x, w1, w2)
+            ms, raw = bench_mlp(mesh, x, w1, w2)
             break
         except RuntimeError as e:
             last_err = e

@@ -27,13 +27,16 @@ def gqa_attention(
     v,
     causal: bool = True,
     q_offset: int | jnp.ndarray = 0,
+    q_positions: Optional[jnp.ndarray] = None,
     kv_len: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
 ):
     """Grouped-query attention forward.
 
     q_offset: absolute position of q row 0 within the KV timeline (decode:
-    cache length). kv_len: optional valid KV prefix length (masks the
+    cache length). q_positions: (B, S) absolute positions of the q rows —
+    the general form (prefill-into-cache, per-batch offsets); overrides
+    q_offset. kv_len: optional valid KV prefix length (masks the
     preallocated cache tail). Returns (B, S, Hq, D) in q.dtype.
     """
     b, s, hq, d = q.shape
@@ -50,12 +53,16 @@ def gqa_attention(
     logits = jnp.einsum("bskgd,btkd->bkgst", qg, kf)
 
     mask = None
+    kpos = jnp.arange(t)
     if causal:
-        qpos = jnp.arange(s)[:, None] + q_offset  # (S, 1)
-        kpos = jnp.arange(t)[None, :]
-        mask = kpos <= qpos  # (S, T)
+        if q_positions is not None:
+            qpos = q_positions[:, :, None]  # (B, S, 1)
+            mask = (kpos[None, None, :] <= qpos)[:, None, None]  # (B,1,1,S,T)
+        else:
+            qpos = jnp.arange(s)[:, None] + q_offset  # (S, 1)
+            mask = kpos[None, :] <= qpos  # (S, T)
     if kv_len is not None:
-        valid = jnp.arange(t)[None, :] < jnp.reshape(kv_len, (-1, 1))  # (B, T)
+        valid = kpos[None, :] < jnp.reshape(kv_len, (-1, 1))  # (B, T)
         valid = valid[:, None, None, None, :]
         mask = valid if mask is None else jnp.logical_and(mask, valid)
     if mask is not None:

@@ -30,7 +30,7 @@ from triton_dist_tpu.kernels import (
     gemm_ar,
     gemm_rs,
 )
-from triton_dist_tpu.layers.attention import gqa_attention, gqa_decode
+from triton_dist_tpu.layers.attention import gqa_attention
 from triton_dist_tpu.layers.norm import rms_norm
 from triton_dist_tpu.layers.rope import apply_rope
 from triton_dist_tpu.runtime.init import TP_AXIS
@@ -90,10 +90,15 @@ def _attn_core(qkv, params, spec, batch, cos, sin, positions, kv_cache,
             "cache tail"
         )
         k_cache, v_cache = kv_cache
-        # Write this step's K/V into the cache at `positions`.
+        # Write this step's K/V into the cache at `positions`, then attend
+        # causally by absolute position — one code path for 1-token decode
+        # and multi-token prefill-into-cache.
         k_cache = _scatter_kv(k_cache, k, positions)
         v_cache = _scatter_kv(v_cache, v, positions)
-        out = gqa_decode(q, k_cache, v_cache, kv_len)
+        out = gqa_attention(
+            q, k_cache, v_cache, causal=True, q_positions=positions,
+            kv_len=kv_len,
+        )
         new_cache = (k_cache, v_cache)
     m = out.shape[0] * out.shape[1]
     return out.reshape(m, spec.num_q_heads * spec.head_dim), new_cache

@@ -1,0 +1,19 @@
+"""Models + inference engine.
+
+TPU-native analog of the reference's model/runtime layer
+(ref: python/triton_dist/models/: Engine, DenseLLM, KV_Cache, ModelConfig,
+AutoLLM). The torch module tree becomes functional params pytrees; CUDA
+graphs become jit executables; HF weight streaming becomes `load_hf`.
+"""
+
+from triton_dist_tpu.models.config import ModelConfig  # noqa: F401
+from triton_dist_tpu.models.kv_cache import KVCache  # noqa: F401
+from triton_dist_tpu.models.dense import (  # noqa: F401
+    DenseLLMParams,
+    DenseLayerParams,
+    forward,
+    init_params,
+    param_specs,
+    cache_specs,
+)
+from triton_dist_tpu.models.engine import Engine, sample_token  # noqa: F401

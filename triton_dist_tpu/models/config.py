@@ -1,0 +1,58 @@
+"""Model configuration.
+
+TPU-native analog of the reference's ModelConfig
+(ref: python/triton_dist/models/config.py:31). Carries the Qwen3-dense
+geometry plus TPU partitioning knobs. Presets mirror the models the
+reference benchmarks (Qwen3-8B/32B, e2e_dense.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 151_936
+    hidden_size: int = 5120
+    intermediate_size: int = 25_600
+    num_layers: int = 64
+    num_q_heads: int = 64
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-6
+    max_positions: int = 4096
+    dtype: str = "bfloat16"
+    # qk-norm (Qwen3 applies rmsnorm over head_dim to q and k)
+    use_qk_norm: bool = True
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def qwen3_32b(**kw) -> "ModelConfig":
+        """Qwen3-32B geometry (the reference's headline e2e model,
+        ref: docs/getting-started/e2e/e2e_dense.md)."""
+        return ModelConfig(
+            vocab_size=151_936, hidden_size=5120, intermediate_size=25_600,
+            num_layers=64, num_q_heads=64, num_kv_heads=8, head_dim=128,
+            **kw,
+        )
+
+    @staticmethod
+    def qwen3_8b(**kw) -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=151_936, hidden_size=4096, intermediate_size=12_288,
+            num_layers=36, num_q_heads=32, num_kv_heads=8, head_dim=128,
+            **kw,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "ModelConfig":
+        """Test-scale config (CPU-mesh parity tests)."""
+        defaults = dict(
+            vocab_size=256, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_q_heads=16, num_kv_heads=8, head_dim=32,
+            max_positions=64, dtype="float32",
+        )
+        defaults.update(kw)
+        return ModelConfig(**defaults)

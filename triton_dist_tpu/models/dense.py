@@ -1,0 +1,211 @@
+"""DenseLLM — Qwen3-style TP transformer over the fused kernel library.
+
+TPU-native re-design of the reference's DenseLLM/DenseLLMLayer
+(ref: python/triton_dist/models/dense.py:53-241): the torch module tree
+with a per-layer fwd mode switch (:84-98) becomes a functional model —
+params are pytrees of per-rank shards (leading mesh-axis dim, consumed by
+shard_map in_specs), the layer stack is a `lax.scan` over stacked layer
+params (one trace for all layers), and the three forward modes mirror the
+reference's torch / triton_dist / triton_dist_AR:
+
+  xla  — unfused collectives (parity reference)
+  dist — ag_gemm/gemm_rs sequence-sharded pipeline (prefill)
+  ar   — replicated activations + gemm_ar (decode / low latency)
+
+Sharding layout per tensor (n = tp size):
+  embed (V, H) replicated · norms (L, H) replicated
+  w_qkv (L, n, H, (Hq+2Hkv)/n*D) · w_o (L, n, Hq/n*D, H)
+  w_gate_up (L, n, H, 2I/n) · w_down (L, n, I/n, H)
+  lm_head (n, H, V/n)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.layers import (
+    TPAttnParams,
+    TPAttnSpec,
+    TPMLPParams,
+    rms_norm,
+    rope_table,
+    tp_attn_fwd,
+    tp_mlp_fwd,
+)
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+class DenseLayerParams(NamedTuple):
+    input_ln: jax.Array
+    post_attn_ln: jax.Array
+    w_qkv: jax.Array
+    w_o: jax.Array
+    q_norm: jax.Array
+    k_norm: jax.Array
+    w_gate_up: jax.Array
+    w_down: jax.Array
+
+
+class DenseLLMParams(NamedTuple):
+    embed: jax.Array
+    layers: DenseLayerParams  # stacked: leading (L, n, ...) dims
+    final_ln: jax.Array
+    lm_head: jax.Array
+
+
+def param_specs(axis: str = TP_AXIS):
+    """shard_map in_specs for DenseLLMParams (leading n dim -> axis)."""
+    layers = DenseLayerParams(
+        input_ln=P(), post_attn_ln=P(),
+        w_qkv=P(None, axis), w_o=P(None, axis),
+        q_norm=P(), k_norm=P(),
+        w_gate_up=P(None, axis), w_down=P(None, axis),
+    )
+    return DenseLLMParams(
+        embed=P(), layers=layers, final_ln=P(), lm_head=P(axis)
+    )
+
+
+def cache_specs(axis: str = TP_AXIS, batch_axis: Optional[str] = None):
+    """KV cache specs: heads shard over tp; batch optionally over dp."""
+    return KVCache(
+        k=P(None, batch_axis, None, axis),
+        v=P(None, batch_axis, None, axis),
+        length=P(batch_axis),
+    )
+
+
+def init_params(
+    cfg: ModelConfig, mesh, seed: int = 0, axis: str = TP_AXIS
+) -> DenseLLMParams:
+    """Random-init global arrays laid out for shard_map (the reference
+    streams HF weights at init, dense.py:150-167; random init keeps the
+    framework dependency-free — `load_hf` maps real checkpoints)."""
+    n = int(mesh.shape[axis])
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(cfg.dtype)
+    h, d = cfg.hidden_size, cfg.head_dim
+    hq_l, hkv_l = cfg.num_q_heads // n, cfg.num_kv_heads // n
+    i_l = cfg.intermediate_size // n
+    v_l = cfg.vocab_size // n
+    L = cfg.num_layers
+
+    def mk(shape, scale=0.02):
+        return jnp.asarray(rng.standard_normal(shape) * scale, dt)
+
+    layers = DenseLayerParams(
+        input_ln=jnp.ones((L, h), dt),
+        post_attn_ln=jnp.ones((L, h), dt),
+        w_qkv=mk((L, n, h, (hq_l + 2 * hkv_l) * d)),
+        w_o=mk((L, n, hq_l * d, h)),
+        q_norm=jnp.ones((L, d), dt),
+        k_norm=jnp.ones((L, d), dt),
+        w_gate_up=mk((L, n, h, 2 * i_l)),
+        w_down=mk((L, n, i_l, h)),
+    )
+    params = DenseLLMParams(
+        embed=mk((cfg.vocab_size, h)),
+        layers=layers,
+        final_ln=jnp.ones((h,), dt),
+        lm_head=mk((n, h, v_l)),
+    )
+    specs = param_specs(axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
+               kv_len, batch, axis, mode, x, lp: DenseLayerParams, kv):
+    """One transformer block (ref DenseLLMLayer.fwd, dense.py:101-114)."""
+    attn_params = TPAttnParams(
+        w_qkv=lp.w_qkv, w_o=lp.w_o,
+        q_norm=lp.q_norm if cfg.use_qk_norm else None,
+        k_norm=lp.k_norm if cfg.use_qk_norm else None,
+    )
+    h = rms_norm(x, lp.input_ln, cfg.rms_eps)
+    attn_out, kv = tp_attn_fwd(
+        h, attn_params, spec, cos, sin, positions, batch,
+        axis=axis, mode=mode, kv_cache=kv, kv_len=kv_len,
+    )
+    x = x + attn_out
+    h = rms_norm(x, lp.post_attn_ln, cfg.rms_eps)
+    x = x + tp_mlp_fwd(h, TPMLPParams(lp.w_gate_up, lp.w_down),
+                       axis=axis, mode=mode)
+    return x, kv
+
+
+def forward(
+    cfg: ModelConfig,
+    params: DenseLLMParams,
+    tokens: jax.Array,  # (B, S) int32, replicated
+    cache: Optional[KVCache],  # per-rank head shards
+    mode: str = "dist",
+    axis: str = TP_AXIS,
+    return_full_logits: bool = False,
+):
+    """Per-device forward (inside shard_map). Returns (logits, new_cache);
+    logits (B, V) for the last position (or (B, S, V) if
+    return_full_logits). Mirrors the reference inference entry
+    (ref: models/dense.py:221-241 `inference`)."""
+    n = jax.lax.axis_size(axis)
+    b, s = tokens.shape
+    h_dim = cfg.hidden_size
+    m = b * s
+    spec = TPAttnSpec(cfg.num_q_heads // n, cfg.num_kv_heads // n,
+                      cfg.head_dim)
+    cos, sin = rope_table(cfg.head_dim, cfg.max_positions, cfg.rope_theta)
+
+    start = cache.length if cache is not None else jnp.zeros((b,), jnp.int32)
+    positions = start[:, None] + jnp.arange(s)[None, :]  # (B, S)
+    kv_len = start + s
+
+    x = params.embed[tokens].reshape(m, h_dim)
+    # `layers` modes get sequence-sharded residuals; ar/xla-decode keeps
+    # them replicated. The xla mode is also sequence-sharded (parity with
+    # dist).
+    seq_sharded = mode in ("dist", "xla")
+    if seq_sharded:
+        assert m % n == 0, f"B*S={m} must divide tp={n} in {mode} mode"
+        me = jax.lax.axis_index(axis)
+        x = jax.lax.dynamic_slice_in_dim(x, me * (m // n), m // n)
+
+    def step(x, xs):
+        lp, k_l, v_l = xs
+        x, kv = _layer_fwd(cfg, spec, cos, sin, positions, kv_len, b,
+                           axis, mode, x, lp, (k_l, v_l))
+        return x, kv
+
+    if cache is None:
+        raise ValueError("forward requires a KVCache (create one per serve)")
+    # strip the n-axis dim (shard_map gives size-1 shards on that dim)
+    lp_local = jax.tree.map(
+        lambda a, sp: a[:, 0] if sp == P(None, axis) else a,
+        params.layers, param_specs(axis).layers,
+    )
+    x, (k_new, v_new) = jax.lax.scan(
+        step, x, (lp_local, cache.k, cache.v)
+    )
+    new_cache = KVCache(k=k_new, v=v_new, length=kv_len)
+
+    if seq_sharded:
+        x = jax.lax.all_gather(x, axis, tiled=True)  # (M, H)
+    x = rms_norm(x, params.final_ln, cfg.rms_eps)
+    x = x.reshape(b, s, h_dim)
+    if not return_full_logits:
+        x = x[:, -1:]
+    head = params.lm_head[0]  # strip n dim
+    logits = jnp.einsum(
+        "bsh,hv->bsv", x.astype(jnp.float32), head.astype(jnp.float32)
+    )
+    logits = jax.lax.all_gather(logits, axis, axis=2, tiled=True)  # (B,S,V)
+    if not return_full_logits:
+        logits = logits[:, 0]
+    return logits, new_cache

@@ -1,0 +1,48 @@
+"""KV cache — preallocated, functionally updated.
+
+TPU-native analog of the reference's KV_Cache
+(ref: python/triton_dist/models/kv_cache.py:29-66): there, per-layer torch
+tensors mutated in place; here, one stacked array per model updated
+functionally and donated through the jit'd decode step, which XLA turns
+into the same in-place update (buffer donation is the TPU idiom for
+mutation under jit).
+
+Shapes (per tp rank): k/v (L, B, T_max, Hkv_loc, D). Inside shard_map the
+head axis is the tp-sharded one.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, T_max, Hkv, D)
+    v: jax.Array  # (L, B, T_max, Hkv, D)
+    length: jax.Array  # (B,) valid entries per sequence
+
+    @staticmethod
+    def create(num_layers, batch, max_len, num_kv_heads, head_dim,
+               dtype=jnp.bfloat16) -> "KVCache":
+        shape = (num_layers, batch, max_len, num_kv_heads, head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+    def layer(self, i):
+        """(k, v) views for layer i (used as tp_attn_fwd's kv_cache)."""
+        return self.k[i], self.v[i]
+
+    def with_layer(self, i, kv) -> "KVCache":
+        k_l, v_l = kv
+        return self._replace(
+            k=self.k.at[i].set(k_l), v=self.v.at[i].set(v_l)
+        )
+
+    def advanced(self, n: int) -> "KVCache":
+        return self._replace(length=self.length + n)
