@@ -52,3 +52,25 @@ from triton_dist_tpu.kernels.p2p import (  # noqa: F401
     p2p_read,
     ring_shift,
 )
+from triton_dist_tpu.kernels.moe_utils import (  # noqa: F401
+    ExpertSort,
+    combine_topk,
+    expert_histogram,
+    sort_by_expert,
+    topk_routing,
+)
+from triton_dist_tpu.kernels.grouped_gemm import (  # noqa: F401
+    grouped_gemm,
+    grouped_gemm_ref,
+)
+from triton_dist_tpu.kernels.allgather_group_gemm import (  # noqa: F401
+    ag_group_gemm,
+    ag_group_gemm_ref,
+    moe_reduce_rs,
+)
+from triton_dist_tpu.kernels.ep_a2a import (  # noqa: F401
+    EPDispatch,
+    ep_combine,
+    ep_dispatch,
+    ep_expert_ffn,
+)

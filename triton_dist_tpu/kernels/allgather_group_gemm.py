@@ -1,0 +1,92 @@
+"""AG + grouped GEMM and grouped GEMM + topk-reduce + RS — the TP-MoE pair.
+
+TPU-native re-design of the reference's TP-MoE kernel pair
+(ref: python/triton_dist/kernels/nvidia/allgather_group_gemm.py:85-199
+sorted gather index from topk ids + :535 consumer group GEMM;
+moe_reduce_rs.py:167-246 grouped GEMM with dl.wait + :293-488
+topk-reduce+RS kernels; host entries `ag_group_gemm`, `run_moe_reduce_rs`).
+
+The overlap structure maps as:
+  - the AG leg reuses the fused ring AG+GEMM machinery where profitable;
+    the gathered tokens feed a `lax.ragged_dot` grouped GEMM (MXU-tiled by
+    XLA over the expert segments the sorted layout provides);
+  - the RS leg reuses the credit-flow ring reduce_scatter kernel; the
+    topk-weighted reduce is the XLA epilogue feeding it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather import ring_all_gather
+from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
+from triton_dist_tpu.kernels.moe_utils import (
+    ExpertSort,
+    combine_topk,
+    sort_by_expert,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_dist_tpu.lang.core import interpret_no_headroom
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+def moe_all_gather(x_shard: jax.Array, axis: str = TP_AXIS) -> jax.Array:
+    """Gather the token shards once per MoE layer (ring kernel when the
+    protocol path is available). The result feeds BOTH the router and the
+    grouped GEMM — gathering twice would double the AG traffic."""
+    n = jax.lax.axis_size(axis)
+    if n == 1 or interpret_no_headroom():
+        return jax.lax.all_gather(x_shard, axis, tiled=True)
+    return ring_all_gather(x_shard, axis)
+
+
+def ag_group_gemm(
+    x_shard: jax.Array,  # (M/n, H) sequence-sharded tokens
+    w_stack: jax.Array,  # (E, H, N_loc) per-expert expert-dim shards
+    sort: ExpertSort,  # routing sort over the FULL M tokens
+    axis: str = TP_AXIS,
+    x_full: Optional[jax.Array] = None,  # pre-gathered tokens, if available
+) -> jax.Array:
+    """AllGather tokens, replicate rows per routed expert (sorted), grouped
+    GEMM against every expert's local N-shard. Returns (M*k, N_loc) in
+    sorted order (ref host entry: allgather_group_gemm.py `ag_group_gemm`).
+    """
+    if x_full is None:
+        x_full = moe_all_gather(x_shard, axis)
+    x_rows = x_full[sort.token_idx]  # (M*k, H) sorted by expert
+    return grouped_gemm(x_rows, w_stack, sort.group_sizes)
+
+
+def moe_reduce_rs(
+    act_sorted: jax.Array,  # (M*k, I_loc) activations in sorted order
+    w_down_stack: jax.Array,  # (E, I_loc, H)
+    sort: ExpertSort,
+    topk_weights: jax.Array,  # (M, k)
+    axis: str = TP_AXIS,
+    out_dtype=None,
+    method: Optional[ReduceScatterMethod] = None,
+) -> jax.Array:
+    """Grouped down-projection + topk-weighted combine + ReduceScatter.
+    Returns (M/n, H) (ref host entry: moe_reduce_rs.py:569
+    `run_moe_reduce_rs`)."""
+    out_dtype = out_dtype or act_sorted.dtype
+    y_sorted = grouped_gemm(
+        act_sorted, w_down_stack, sort.group_sizes, out_dtype=jnp.float32
+    )
+    y = combine_topk(y_sorted, sort, topk_weights)  # (M, H) f32
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return y.astype(out_dtype)
+    return reduce_scatter(y.astype(out_dtype), axis, method=method)
+
+
+def ag_group_gemm_ref(x_shard, w_stack, sort, axis: str = TP_AXIS):
+    """Unfused XLA reference (AG + ragged_dot)."""
+    x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
+    return grouped_gemm(x_full[sort.token_idx], w_stack, sort.group_sizes)

@@ -1,0 +1,188 @@
+"""EP dispatch/combine — expert-parallel token exchange over the A2A.
+
+TPU-native re-design of the reference's EP A2A
+(ref: python/triton_dist/kernels/nvidia/ep_a2a.py:37-150
+`kernel_dispatch_token`: tokens pushed to expert-owner ranks with per-
+expert atomic slot allocation; :152 `kernel_combine_token`; :244-309 splits
+AG + recv-offset calculation). The reference allocates receive slots with
+device atomics because its shapes are dynamic; XLA requires static shapes,
+so the TPU design uses the standard capacity-factor formulation: each rank
+packs at most `capacity` routed (token, expert) pairs per destination rank
+— overflow tokens are dropped from the MoE sum (they keep their residual
+path), the same trade GShard/Switch make. The transport is the one-kernel
+Pallas all_to_all (all segments + counts in flight concurrently).
+
+Metadata rides as a second A2A payload: (src_row, local_expert, weight,
+valid) per slot, the splits-metadata analog.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.all_to_all import all_to_all, all_to_all_ref
+from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
+from triton_dist_tpu.lang.core import interpret_no_headroom
+from triton_dist_tpu.runtime.init import EP_AXIS
+
+
+class EPDispatch(NamedTuple):
+    """Received tokens + routing metadata after dispatch.
+
+    The send_* fields are the ORIGIN-side copies of what this rank packed
+    for each destination: combine pairs them with the returning segments
+    (back[j] is by construction the segment we sent to rank j), so no
+    metadata needs to travel back — one a2a saved per combine."""
+
+    x: jax.Array  # (n, C, H) tokens from each source rank
+    local_expert: jax.Array  # (n, C) expert index within this rank
+    valid: jax.Array  # (n, C) bool, recv side
+    counts: jax.Array  # (n,) valid slots per received segment
+    send_src_row: jax.Array  # (n, C) our token row per sent slot
+    send_weight: jax.Array  # (n, C) topk weight per sent slot
+    send_valid: jax.Array  # (n, C) bool, send side
+    send_counts: jax.Array  # (n,) slots we sent per destination
+
+
+def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
+    """Build fixed-capacity per-destination send buffers.
+
+    x: (M, H); ids/weights: (M, k). Returns (send_x (n, C, H),
+    meta (n, C, 3) [src_row, local_expert, weight_bits], counts (n,)).
+    Slot allocation is a stable sort by destination rank — the static
+    analog of the reference's atomic slot counter (ep_a2a.py:133-150).
+    """
+    m, k = ids.shape
+    flat_ids = ids.reshape(-1)
+    dest = flat_ids // experts_per_rank  # (M*k,)
+    order = jnp.argsort(dest, stable=True)
+    # position of each flat entry within its destination segment
+    seg_count = jnp.bincount(dest, length=n_ranks)
+    seg_start = jnp.cumsum(seg_count) - seg_count
+    sorted_dest = dest[order]
+    pos_in_seg = jnp.arange(m * k) - seg_start[sorted_dest]
+    keep = pos_in_seg < capacity  # overflow -> dropped
+
+    # Overflow entries get an out-of-range slot so mode="drop" discards
+    # them (clamping would overwrite the segment's last valid slot).
+    slot = jnp.where(
+        keep, sorted_dest * capacity + pos_in_seg, n_ranks * capacity
+    )
+    src_rows = (order // k).astype(jnp.int32)
+    local_exp = (flat_ids[order] % experts_per_rank).astype(jnp.int32)
+    w_flat = weights.reshape(-1)[order].astype(jnp.float32)
+
+    send_x = jnp.zeros((n_ranks * capacity, x.shape[1]), x.dtype)
+    send_x = send_x.at[slot].set(x[src_rows], mode="drop")
+    # travelling metadata: only the local expert id (the recv side needs
+    # nothing else; src_row/weight stay origin-side for combine)
+    meta = jnp.zeros((n_ranks * capacity, 1), jnp.float32)
+    meta = meta.at[slot].set(
+        local_exp.astype(jnp.float32)[:, None], mode="drop"
+    )
+    send_row = jnp.zeros((n_ranks * capacity,), jnp.int32)
+    send_row = send_row.at[slot].set(src_rows, mode="drop")
+    send_w = jnp.zeros((n_ranks * capacity,), jnp.float32)
+    send_w = send_w.at[slot].set(w_flat, mode="drop")
+    valid = jnp.zeros((n_ranks * capacity,), jnp.bool_)
+    valid = valid.at[slot].set(True, mode="drop")
+    counts = jnp.minimum(seg_count, capacity).astype(jnp.int32)
+    c = capacity
+    return (
+        send_x.reshape(n_ranks, c, -1),
+        meta.reshape(n_ranks, c, 1),
+        send_row.reshape(n_ranks, c),
+        send_w.reshape(n_ranks, c),
+        valid.reshape(n_ranks, c),
+        counts,
+    )
+
+
+def ep_dispatch(
+    x: jax.Array,  # (M, H) this rank's tokens
+    topk_ids: jax.Array,  # (M, k) global expert ids
+    topk_weights: jax.Array,  # (M, k)
+    n_experts: int,
+    capacity: int,
+    axis: str = EP_AXIS,
+) -> EPDispatch:
+    """Route tokens to their expert-owner ranks (ref dispatch path,
+    ep_a2a.py:37-150 + layers/nvidia/ep_a2a_layer.py:195)."""
+    n = jax.lax.axis_size(axis)
+    experts_per_rank = n_experts // n
+    send_x, meta, send_row, send_w, send_valid, counts = _pack_by_dest(
+        x, topk_ids, topk_weights, n, experts_per_rank, capacity
+    )
+    a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
+    recv_x, _ = a2a(send_x, counts, axis)
+    recv_meta, recv_counts = a2a(meta, counts, axis)
+    slot_idx = jnp.arange(capacity)[None, :]
+    recv_valid = slot_idx < recv_counts[:, None]
+    return EPDispatch(
+        x=recv_x,
+        local_expert=recv_meta[..., 0].astype(jnp.int32),
+        valid=recv_valid,
+        counts=recv_counts,
+        send_src_row=send_row,
+        send_weight=send_w,
+        send_valid=send_valid,
+        send_counts=counts,
+    )
+
+
+def ep_expert_ffn(
+    disp: EPDispatch,
+    w_gate_up: jax.Array,  # (E_loc, H, 2I)
+    w_down: jax.Array,  # (E_loc, I, H)
+) -> jax.Array:
+    """Run this rank's experts over the received tokens -> (n, C, H).
+
+    Tokens are sorted by local expert (invalid slots to a trailing null
+    group) so the grouped GEMM sees contiguous segments."""
+    e_loc = w_gate_up.shape[0]
+    n, c, h = disp.x.shape
+    t = n * c
+    x_flat = disp.x.reshape(t, h)
+    exp = jnp.where(disp.valid.reshape(t), disp.local_expert.reshape(t), e_loc)
+    order = jnp.argsort(exp, stable=True).astype(jnp.int32)
+    inv = jnp.argsort(order, stable=True).astype(jnp.int32)
+    group_sizes = jnp.bincount(exp, length=e_loc + 1).astype(jnp.int32)
+
+    x_sorted = x_flat[order]
+    # null group (invalid slots) gets expert 0's weights; its outputs are
+    # masked out below
+    w_gu = jnp.concatenate([w_gate_up, w_gate_up[:1]], axis=0)
+    w_dn = jnp.concatenate([w_down, w_down[:1]], axis=0)
+    hh = grouped_gemm(x_sorted, w_gu, group_sizes, out_dtype=jnp.float32)
+    gate, up = jnp.split(hh, 2, axis=-1)
+    act = (jax.nn.silu(gate) * up).astype(disp.x.dtype)
+    y_sorted = grouped_gemm(act, w_dn, group_sizes, out_dtype=jnp.float32)
+    y = y_sorted[inv].reshape(n, c, h)
+    return jnp.where(disp.valid[..., None], y, 0.0)
+
+
+def ep_combine(
+    y: jax.Array,  # (n, C, H) expert outputs per source rank
+    disp: EPDispatch,
+    m: int,
+    out_dtype,
+    axis: str = EP_AXIS,
+) -> jax.Array:
+    """Send results back to their source ranks and weighted-scatter into
+    (M, H) (ref combine path, ep_a2a.py:152 + ep_a2a_layer.py:240).
+
+    back[j] is the segment this rank originally packed for rank j, so the
+    origin-side send_* metadata pairs with it directly — no metadata
+    travels back."""
+    a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
+    back, _ = a2a(y.astype(jnp.float32), disp.counts, axis)
+    n, c, h = back.shape
+    rows = disp.send_src_row.reshape(-1)
+    w = jnp.where(disp.send_valid, disp.send_weight, 0.0).reshape(-1)
+    contrib = back.reshape(-1, h) * w[:, None]
+    out = jnp.zeros((m, h), jnp.float32)
+    out = out.at[rows].add(contrib, mode="drop")
+    return out.astype(out_dtype)

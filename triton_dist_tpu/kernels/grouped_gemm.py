@@ -1,0 +1,49 @@
+"""Grouped GEMM over expert segments — the MoE matmul core.
+
+TPU-native analog of the reference's grouped-GEMM consumers
+(ref: python/triton_dist/kernels/nvidia/allgather_group_gemm.py:535
+`consumer scatter-group-GEMM`; moe_reduce_rs.py:167-246). The reference
+hand-tiles a Triton kernel over sorted token blocks with per-block expert
+ids; on TPU `lax.ragged_dot` is the native expression — XLA lowers it onto
+the MXU with contiguous group segments, which is exactly what the sorted
+token layout provides.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_gemm(
+    x_sorted: jax.Array,  # (T, K) tokens sorted by expert
+    w_stack: jax.Array,  # (E, K, N) per-expert weights
+    group_sizes: jax.Array,  # (E,) rows per expert
+    out_dtype=None,
+) -> jax.Array:
+    """y[i] = x_sorted[i] @ w_stack[expert_of_segment(i)] -> (T, N)."""
+    out_dtype = out_dtype or x_sorted.dtype
+    y = jax.lax.ragged_dot(
+        x_sorted, w_stack, group_sizes,
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(out_dtype)
+
+
+def grouped_gemm_ref(x_sorted, w_stack, group_sizes, out_dtype=None):
+    """Loop-over-experts reference (masked einsum; O(E) passes)."""
+    out_dtype = out_dtype or x_sorted.dtype
+    e = w_stack.shape[0]
+    t = x_sorted.shape[0]
+    starts = jnp.cumsum(group_sizes) - group_sizes
+    rows = jnp.arange(t)[:, None]
+    # membership mask (T, E)
+    member = (rows >= starts[None, :]) & (
+        rows < (starts + group_sizes)[None, :]
+    )
+    xf = x_sorted.astype(jnp.float32)
+    acc = jnp.zeros((t, w_stack.shape[2]), jnp.float32)
+    for ei in range(e):
+        y = xf @ w_stack[ei].astype(jnp.float32)
+        acc = jnp.where(member[:, ei:ei + 1], y, acc)
+    return acc.astype(out_dtype)

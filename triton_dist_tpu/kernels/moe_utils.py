@@ -1,0 +1,75 @@
+"""MoE routing utilities — topk routing + expert-aligned token sort.
+
+TPU-native re-design of the reference's MoE utils
+(ref: python/triton_dist/kernels/nvidia/moe_utils.py:1-405 topk
+reduce/histogram; csrc/lib/moe_utils.cu:61-165
+`moe_ag_scatter_align_block_size`, the CUDA kernel building the sorted
+token->block mapping). On TPU the alignment problem disappears:
+`lax.ragged_dot` takes contiguous group sizes directly, so the "align to
+GEMM block size" native op reduces to a stable argsort by expert id +
+bincount — static shapes, no atomics, fully fused by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_routing(
+    router_logits: jax.Array,  # (M, E) f32
+    k: int,
+    normalize: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Softmax-then-topk router (Qwen3MoE's norm_topk_prob convention,
+    ref: models/qwen_moe.py:50-206). Returns (weights (M, k) f32,
+    ids (M, k) int32)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    weights, ids = jax.lax.top_k(probs, k)
+    if normalize:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids.astype(jnp.int32)
+
+
+def expert_histogram(topk_ids: jax.Array, n_experts: int) -> jax.Array:
+    """Tokens per expert (the reference's triton bincount,
+    ref: kernels/nvidia/ep_a2a.py:310-336)."""
+    return jnp.bincount(topk_ids.reshape(-1), length=n_experts).astype(
+        jnp.int32
+    )
+
+
+class ExpertSort(NamedTuple):
+    """Sorted (token, choice) pairs grouped by expert — the align-block-
+    size output analog (ref: csrc/lib/moe_utils.cu:61-165)."""
+
+    sort_idx: jax.Array  # (M*k,) flat position -> original flat (tok*k+j)
+    token_idx: jax.Array  # (M*k,) source token row per sorted position
+    group_sizes: jax.Array  # (E,) tokens per expert, sorted-order segments
+    unsort_idx: jax.Array  # (M*k,) original flat -> sorted position
+
+
+def sort_by_expert(topk_ids: jax.Array, n_experts: int) -> ExpertSort:
+    """Stable sort of the (M, k) routing table by expert id."""
+    m, k = topk_ids.shape
+    flat = topk_ids.reshape(-1)
+    sort_idx = jnp.argsort(flat, stable=True).astype(jnp.int32)
+    group_sizes = expert_histogram(topk_ids, n_experts)
+    unsort_idx = jnp.argsort(sort_idx, stable=True).astype(jnp.int32)
+    token_idx = (sort_idx // k).astype(jnp.int32)
+    return ExpertSort(sort_idx, token_idx, group_sizes, unsort_idx)
+
+
+def combine_topk(
+    y_sorted: jax.Array,  # (M*k, H) expert outputs in sorted order
+    sort: ExpertSort,
+    topk_weights: jax.Array,  # (M, k) f32
+) -> jax.Array:
+    """Unsort + weighted sum over the k choices -> (M, H) f32
+    (the reference's topk-reduce, moe_reduce_rs.py:293-488)."""
+    m, k = topk_weights.shape
+    y_flat = y_sorted[sort.unsort_idx]  # (M*k, H) original order
+    y_flat = y_flat.reshape(m, k, -1).astype(jnp.float32)
+    return jnp.einsum("mkh,mk->mh", y_flat, topk_weights)

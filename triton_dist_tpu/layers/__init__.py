@@ -10,10 +10,7 @@ modules; each carries the same three-mode switch (xla / dist / ar).
 
 from triton_dist_tpu.layers.norm import rms_norm  # noqa: F401
 from triton_dist_tpu.layers.rope import rope_table, apply_rope  # noqa: F401
-from triton_dist_tpu.layers.attention import (  # noqa: F401
-    gqa_attention,
-    gqa_decode,
-)
+from triton_dist_tpu.layers.attention import gqa_attention  # noqa: F401
 from triton_dist_tpu.layers.tp_mlp import (  # noqa: F401
     TPMLPParams,
     tp_mlp_fwd,
@@ -30,3 +27,9 @@ from triton_dist_tpu.layers.tp_attn import (  # noqa: F401
     tp_attn_ar_fwd,
 )
 from triton_dist_tpu.layers.p2p import PPCommOp, pp_schedule_fwd  # noqa: F401
+from triton_dist_tpu.layers.tp_moe import TPMoEParams, tp_moe_fwd  # noqa: F401
+from triton_dist_tpu.layers.ep_moe import (  # noqa: F401
+    EPMoEParams,
+    ep_moe_fwd,
+    ep_moe_ref,
+)

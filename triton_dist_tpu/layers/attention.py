@@ -75,14 +75,3 @@ def gqa_attention(
 
     out = jnp.einsum("bkgst,btkd->bskgd", p, vf)
     return out.reshape(b, s, hq, d).astype(q.dtype)
-
-
-def gqa_decode(q, k_cache, v_cache, kv_len, scale: Optional[float] = None):
-    """One-token decode against a preallocated KV cache.
-
-    q: (B, 1, Hq, D); k_cache/v_cache: (B, T_max, Hkv, D); kv_len: (B,)
-    number of valid entries (including the token written this step).
-    """
-    return gqa_attention(
-        q, k_cache, v_cache, causal=False, kv_len=kv_len, scale=scale
-    )

@@ -1,0 +1,96 @@
+"""TP MoE layer — router + AG grouped-GEMM + grouped-GEMM reduce RS.
+
+TPU-native re-design of the reference's TP_MoE
+(ref: python/triton_dist/layers/nvidia/tp_moe.py:48-280, dist fwd :237):
+every rank holds an expert-dim slice of EVERY expert (w_gate_up
+(E, H, 2I/n), w_down (E, I/n, H)); tokens are gathered, routed, sorted by
+expert, pushed through the grouped GEMMs, topk-combined, and
+reduce-scattered back to the sequence shards.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather_group_gemm import (
+    ag_group_gemm,
+    ag_group_gemm_ref,
+    moe_all_gather,
+    moe_reduce_rs,
+)
+from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
+from triton_dist_tpu.kernels.moe_utils import (
+    combine_topk,
+    sort_by_expert,
+    topk_routing,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+class TPMoEParams(NamedTuple):
+    """w_router (H, E) replicated; expert stacks sharded on the expert
+    FFN dim: w_gate_up (E, H, 2*I/n), w_down (E, I/n, H)."""
+
+    w_router: jax.Array
+    w_gate_up: jax.Array
+    w_down: jax.Array
+
+
+def _silu_mul(h):
+    gate, up = jnp.split(h.astype(jnp.float32), 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def tp_moe_fwd(
+    x_shard: jax.Array,  # (M/n, H); (M, H) replicated in 'ar' mode
+    params: TPMoEParams,
+    top_k: int,
+    axis: str = TP_AXIS,
+    mode: str = "dist",
+):
+    """TP-MoE forward (ref: tp_moe.py:237 dist fwd; :107 torch fwd for
+    mode='xla'; AR analog for the replicated decode path). Sequence-sharded
+    modes return (M/n, H); 'ar' returns (M, H) replicated."""
+    n_experts = params.w_router.shape[-1]
+    # Router on the full token set. Router logits must be identical on all
+    # ranks (the sort permutation must agree), so compute from the gathered
+    # tokens in f32.
+    if mode == "ar":
+        x_full = x_shard  # already replicated
+    elif mode == "xla":
+        x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
+    else:
+        x_full = moe_all_gather(x_shard, axis)  # shared: router + GEMM
+    logits = jnp.dot(
+        x_full.astype(jnp.float32), params.w_router.astype(jnp.float32)
+    )
+    weights, ids = topk_routing(logits, top_k)
+    sort = sort_by_expert(ids, n_experts)
+
+    if mode == "ar":
+        h = grouped_gemm(x_full[sort.token_idx], params.w_gate_up,
+                         sort.group_sizes)
+        act = _silu_mul(h).astype(x_shard.dtype)
+        y_sorted = grouped_gemm(
+            act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
+        )
+        y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
+        return jax.lax.psum(y, axis)
+
+    if mode == "xla":
+        h = ag_group_gemm_ref(x_shard, params.w_gate_up, sort, axis)
+        act = _silu_mul(h).astype(x_shard.dtype)
+        y_sorted = grouped_gemm(
+            act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
+        )
+        y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
+        return jax.lax.psum_scatter(y, axis, tiled=True)
+
+    h = ag_group_gemm(x_shard, params.w_gate_up, sort, axis, x_full=x_full)
+    act = _silu_mul(h).astype(x_shard.dtype)
+    return moe_reduce_rs(
+        act, params.w_down, sort, weights, axis, out_dtype=x_shard.dtype
+    )

@@ -17,3 +17,7 @@ from triton_dist_tpu.models.dense import (  # noqa: F401
     cache_specs,
 )
 from triton_dist_tpu.models.engine import Engine, sample_token  # noqa: F401
+from triton_dist_tpu.models.qwen_moe import (  # noqa: F401
+    auto_engine,
+    qwen3_moe_engine,
+)

@@ -27,6 +27,14 @@ class ModelConfig:
     # qk-norm (Qwen3 applies rmsnorm over head_dim to q and k)
     use_qk_norm: bool = True
     tie_word_embeddings: bool = False
+    # MoE (0 experts = dense; ref: models/qwen_moe.py Qwen3MoE)
+    num_experts: int = 0
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int = 0
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @staticmethod
     def qwen3_32b(**kw) -> "ModelConfig":
@@ -45,6 +53,29 @@ class ModelConfig:
             num_layers=36, num_q_heads=32, num_kv_heads=8, head_dim=128,
             **kw,
         )
+
+    @staticmethod
+    def qwen3_30b_a3b(**kw) -> "ModelConfig":
+        """Qwen3-30B-A3B MoE geometry (the reference's Qwen3MoE model,
+        ref: models/qwen_moe.py:50-206)."""
+        return ModelConfig(
+            vocab_size=151_936, hidden_size=2048, intermediate_size=6144,
+            num_layers=48, num_q_heads=32, num_kv_heads=4, head_dim=128,
+            num_experts=128, num_experts_per_tok=8,
+            moe_intermediate_size=768, **kw,
+        )
+
+    @staticmethod
+    def tiny_moe(**kw) -> "ModelConfig":
+        """Test-scale MoE config."""
+        defaults = dict(
+            vocab_size=256, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_q_heads=16, num_kv_heads=8, head_dim=32,
+            max_positions=64, dtype="float32",
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=64,
+        )
+        defaults.update(kw)
+        return ModelConfig(**defaults)
 
     @staticmethod
     def tiny(**kw) -> "ModelConfig":

@@ -49,8 +49,11 @@ class DenseLayerParams(NamedTuple):
     w_o: jax.Array
     q_norm: jax.Array
     k_norm: jax.Array
+    # dense: w_gate_up (L, n, H, 2I/n), w_down (L, n, I/n, H)
+    # MoE:   w_gate_up (L, n, E, H, 2I_moe/n), w_down (L, n, E, I_moe/n, H)
     w_gate_up: jax.Array
     w_down: jax.Array
+    w_router: Optional[jax.Array] = None  # MoE only: (L, H, E) replicated
 
 
 class DenseLLMParams(NamedTuple):
@@ -60,13 +63,14 @@ class DenseLLMParams(NamedTuple):
     lm_head: jax.Array
 
 
-def param_specs(axis: str = TP_AXIS):
+def param_specs(axis: str = TP_AXIS, moe: bool = False):
     """shard_map in_specs for DenseLLMParams (leading n dim -> axis)."""
     layers = DenseLayerParams(
         input_ln=P(), post_attn_ln=P(),
         w_qkv=P(None, axis), w_o=P(None, axis),
         q_norm=P(), k_norm=P(),
         w_gate_up=P(None, axis), w_down=P(None, axis),
+        w_router=P() if moe else None,
     )
     return DenseLLMParams(
         embed=P(), layers=layers, final_ln=P(), lm_head=P(axis)
@@ -100,6 +104,20 @@ def init_params(
     def mk(shape, scale=0.02):
         return jnp.asarray(rng.standard_normal(shape) * scale, dt)
 
+    if cfg.is_moe:
+        e = cfg.num_experts
+        mi_l = cfg.moe_intermediate_size // n
+        ffn = dict(
+            w_gate_up=mk((L, n, e, h, 2 * mi_l)),
+            w_down=mk((L, n, e, mi_l, h)),
+            w_router=mk((L, h, e)),
+        )
+    else:
+        ffn = dict(
+            w_gate_up=mk((L, n, h, 2 * i_l)),
+            w_down=mk((L, n, i_l, h)),
+            w_router=None,
+        )
     layers = DenseLayerParams(
         input_ln=jnp.ones((L, h), dt),
         post_attn_ln=jnp.ones((L, h), dt),
@@ -107,8 +125,7 @@ def init_params(
         w_o=mk((L, n, hq_l * d, h)),
         q_norm=jnp.ones((L, d), dt),
         k_norm=jnp.ones((L, d), dt),
-        w_gate_up=mk((L, n, h, 2 * i_l)),
-        w_down=mk((L, n, i_l, h)),
+        **ffn,
     )
     params = DenseLLMParams(
         embed=mk((cfg.vocab_size, h)),
@@ -116,7 +133,7 @@ def init_params(
         final_ln=jnp.ones((h,), dt),
         lm_head=mk((n, h, v_l)),
     )
-    specs = param_specs(axis)
+    specs = param_specs(axis, cfg.is_moe)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
@@ -137,8 +154,17 @@ def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
     )
     x = x + attn_out
     h = rms_norm(x, lp.post_attn_ln, cfg.rms_eps)
-    x = x + tp_mlp_fwd(h, TPMLPParams(lp.w_gate_up, lp.w_down),
-                       axis=axis, mode=mode)
+    if cfg.is_moe:
+        from triton_dist_tpu.layers import TPMoEParams, tp_moe_fwd
+
+        mlp_out = tp_moe_fwd(
+            h, TPMoEParams(lp.w_router, lp.w_gate_up, lp.w_down),
+            cfg.num_experts_per_tok, axis=axis, mode=mode,
+        )
+    else:
+        mlp_out = tp_mlp_fwd(h, TPMLPParams(lp.w_gate_up, lp.w_down),
+                             axis=axis, mode=mode)
+    x = x + mlp_out
     return x, kv
 
 
@@ -155,6 +181,8 @@ def forward(
     logits (B, V) for the last position (or (B, S, V) if
     return_full_logits). Mirrors the reference inference entry
     (ref: models/dense.py:221-241 `inference`)."""
+    if cache is None:
+        raise ValueError("forward requires a KVCache (create one per serve)")
     n = jax.lax.axis_size(axis)
     b, s = tokens.shape
     h_dim = cfg.hidden_size
@@ -163,7 +191,7 @@ def forward(
                       cfg.head_dim)
     cos, sin = rope_table(cfg.head_dim, cfg.max_positions, cfg.rope_theta)
 
-    start = cache.length if cache is not None else jnp.zeros((b,), jnp.int32)
+    start = cache.length
     positions = start[:, None] + jnp.arange(s)[None, :]  # (B, S)
     kv_len = start + s
 
@@ -183,12 +211,10 @@ def forward(
                            axis, mode, x, lp, (k_l, v_l))
         return x, kv
 
-    if cache is None:
-        raise ValueError("forward requires a KVCache (create one per serve)")
     # strip the n-axis dim (shard_map gives size-1 shards on that dim)
     lp_local = jax.tree.map(
         lambda a, sp: a[:, 0] if sp == P(None, axis) else a,
-        params.layers, param_specs(axis).layers,
+        params.layers, param_specs(axis, cfg.is_moe).layers,
     )
     x, (k_new, v_new) = jax.lax.scan(
         step, x, (lp_local, cache.k, cache.v)

@@ -71,7 +71,7 @@ class Engine:
         n = int(mesh.shape[axis])
         self._hkv_loc = cfg.num_kv_heads // n
 
-        p_specs = param_specs(axis)
+        p_specs = param_specs(axis, cfg.is_moe)
         c_specs = cache_specs(axis, batch_axis)
         t_spec = P(batch_axis)
 
@@ -141,7 +141,8 @@ class Engine:
         key = jax.random.PRNGKey(seed)
         logits, cache = self.prefill(input_ids)
         out = []
-        tok = sample_token(logits, key, temperature)
+        key, sub = jax.random.split(key)
+        tok = sample_token(logits, sub, temperature)
         out.append(tok)
         for _ in range(gen_len - 1):
             key, sub = jax.random.split(key)

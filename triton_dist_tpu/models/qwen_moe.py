@@ -1,0 +1,34 @@
+"""Qwen3MoE — the MoE model family entry point.
+
+TPU-native analog of the reference's Qwen3MoE
+(ref: python/triton_dist/models/qwen_moe.py:50-206). The MoE transformer
+shares the DenseLLM skeleton (dense.py) — per-layer MLPs swap for TP-MoE
+blocks when cfg.num_experts > 0 — so prefill/decode/engine/cache all come
+for free; this module carries the family presets and a convenience
+constructor, the AutoLLM dispatch analog (ref: models/__init__.py AutoLLM).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.engine import Engine
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+def qwen3_moe_engine(
+    mesh,
+    cfg: Optional[ModelConfig] = None,
+    axis: str = TP_AXIS,
+    **kw,
+) -> Engine:
+    """Engine for a Qwen3MoE model (defaults to Qwen3-30B-A3B geometry)."""
+    cfg = cfg or ModelConfig.qwen3_30b_a3b()
+    assert cfg.is_moe, "qwen3_moe_engine requires an MoE config"
+    return Engine(cfg, mesh, axis=axis, **kw)
+
+
+def auto_engine(mesh, cfg: ModelConfig, **kw) -> Engine:
+    """AutoLLM analog: dispatch on config (dense vs MoE share the Engine)."""
+    return Engine(cfg, mesh, **kw)
