@@ -74,3 +74,12 @@ from triton_dist_tpu.kernels.ep_a2a import (  # noqa: F401
     ep_dispatch,
     ep_expert_ffn,
 )
+from triton_dist_tpu.kernels.sp_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_ref,
+)
+from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
+    flash_decode_combine,
+    flash_decode_partial,
+    sp_flash_decode,
+)

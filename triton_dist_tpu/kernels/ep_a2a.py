@@ -50,10 +50,12 @@ class EPDispatch(NamedTuple):
 def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
     """Build fixed-capacity per-destination send buffers.
 
-    x: (M, H); ids/weights: (M, k). Returns (send_x (n, C, H),
-    meta (n, C, 3) [src_row, local_expert, weight_bits], counts (n,)).
-    Slot allocation is a stable sort by destination rank — the static
-    analog of the reference's atomic slot counter (ep_a2a.py:133-150).
+    x: (M, H); ids/weights: (M, k). Returns (send_x (n, C, H_pad) with the
+    local-expert id folded into column H of the lane padding — one a2a
+    moves tokens AND routing; send_row/send_w/valid (n, C) origin-side
+    combine metadata; counts (n,)). Slot allocation is a stable sort by
+    destination rank — the static analog of the reference's atomic slot
+    counter (ep_a2a.py:133-150).
     """
     m, k = ids.shape
     flat_ids = ids.reshape(-1)
@@ -75,13 +77,19 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
     local_exp = (flat_ids[order] % experts_per_rank).astype(jnp.int32)
     w_flat = weights.reshape(-1)[order].astype(jnp.float32)
 
-    send_x = jnp.zeros((n_ranks * capacity, x.shape[1]), x.dtype)
-    send_x = send_x.at[slot].set(x[src_rows], mode="drop")
-    # travelling metadata: only the local expert id (the recv side needs
-    # nothing else; src_row/weight stay origin-side for combine)
-    meta = jnp.zeros((n_ranks * capacity, 1), jnp.float32)
-    meta = meta.at[slot].set(
-        local_exp.astype(jnp.float32)[:, None], mode="drop"
+    h = x.shape[1]
+    # Fold the travelling metadata (local expert id, the only field the
+    # recv side needs) into lane-padding columns of the token payload so a
+    # SINGLE a2a moves tokens + routing. Expert ids are small integers and
+    # exact in bf16 (<= 256).
+    assert experts_per_rank <= 256 or jnp.dtype(x.dtype).itemsize >= 4, (
+        "expert id not exactly representable in bf16 lane padding"
+    )
+    h_pad = -(-(h + 1) // 128) * 128  # round_up(H+1, 128): aligned lanes
+    send_x = jnp.zeros((n_ranks * capacity, h_pad), x.dtype)
+    send_x = send_x.at[slot, :h].set(x[src_rows], mode="drop")
+    send_x = send_x.at[slot, h].set(
+        local_exp.astype(x.dtype), mode="drop"
     )
     send_row = jnp.zeros((n_ranks * capacity,), jnp.int32)
     send_row = send_row.at[slot].set(src_rows, mode="drop")
@@ -92,8 +100,7 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
     counts = jnp.minimum(seg_count, capacity).astype(jnp.int32)
     c = capacity
     return (
-        send_x.reshape(n_ranks, c, -1),
-        meta.reshape(n_ranks, c, 1),
+        send_x.reshape(n_ranks, c, h_pad),
         send_row.reshape(n_ranks, c),
         send_w.reshape(n_ranks, c),
         valid.reshape(n_ranks, c),
@@ -112,18 +119,18 @@ def ep_dispatch(
     """Route tokens to their expert-owner ranks (ref dispatch path,
     ep_a2a.py:37-150 + layers/nvidia/ep_a2a_layer.py:195)."""
     n = jax.lax.axis_size(axis)
+    h = x.shape[1]
     experts_per_rank = n_experts // n
-    send_x, meta, send_row, send_w, send_valid, counts = _pack_by_dest(
+    send_x, send_row, send_w, send_valid, counts = _pack_by_dest(
         x, topk_ids, topk_weights, n, experts_per_rank, capacity
     )
     a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
-    recv_x, _ = a2a(send_x, counts, axis)
-    recv_meta, recv_counts = a2a(meta, counts, axis)
+    recv, recv_counts = a2a(send_x, counts, axis)
     slot_idx = jnp.arange(capacity)[None, :]
     recv_valid = slot_idx < recv_counts[:, None]
     return EPDispatch(
-        x=recv_x,
-        local_expert=recv_meta[..., 0].astype(jnp.int32),
+        x=recv[..., :h],
+        local_expert=recv[..., h].astype(jnp.int32),
         valid=recv_valid,
         counts=recv_counts,
         send_src_row=send_row,

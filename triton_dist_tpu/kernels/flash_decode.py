@@ -1,0 +1,102 @@
+"""Distributed flash-decode — split-KV decode with cross-rank LSE combine.
+
+TPU-native re-design of the reference's distributed flash-decode
+(ref: python/triton_dist/kernels/nvidia/flash_decode.py: split-KV GQA
+decode :130/:587, intra-rank combine emitting (acc, lse) partials :393-480,
+inter-rank online-softmax combine :482-531). The KV cache shards by
+sequence across the sp axis; each rank computes a masked partial decode
+over its shard plus the log-sum-exp, the (acc, lse) partials are exchanged
+with a small-message allgather (the reference uses its LL allgather for
+this, sp_flash_decode_layer.py:136-146), and the merge is the standard
+attention-partial combine: out = Σ_i exp(lse_i - lse*) o_i / Σ_i
+exp(lse_i - lse*).
+
+This is the 1→32-GPU decode-scaling mechanism of README.md:199-202, mapped
+to ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.runtime.init import SP_AXIS
+
+NEG_INF = -1e30
+
+
+def flash_decode_partial(
+    q: jax.Array,  # (B, Hq, D) one decode token per sequence
+    k_loc: jax.Array,  # (B, T_loc, Hkv, D) this rank's KV shard
+    v_loc: jax.Array,
+    valid_len: jax.Array,  # (B,) valid rows in this shard
+    scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Partial attention over the local KV shard.
+
+    Returns (o (B, Hq, D) f32 — the *unnormalized-softmax* partial output
+    normalized by the local sum, and lse (B, Hq) f32 — the local
+    log-sum-exp). Mirrors the reference's split-kv kernel contract
+    (flash_decode.py:393-480)."""
+    b, hq, d = q.shape
+    _, t, hkv, _ = k_loc.shape
+    g = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32).reshape(b, hkv, g, d) * scale
+    kf = k_loc.astype(jnp.float32)
+    vf = v_loc.astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qf, kf)  # (B, Hkv, G, T)
+    mask = jnp.arange(t)[None, :] < valid_len[:, None]  # (B, T)
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B, Hkv, G, 1)
+    safe_m = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(m <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bkgt,btkd->bkgd", p, vf) / jnp.maximum(l, 1e-30)
+    lse = (safe_m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (B, Hkv, G)
+    empty = (m <= NEG_INF / 2)[..., 0]
+    lse = jnp.where(empty, NEG_INF, lse)
+    return o.reshape(b, hq, d), lse.reshape(b, hq)
+
+
+def flash_decode_combine(
+    o_parts: jax.Array,  # (n, B, Hq, D) f32 per-rank partials
+    lse_parts: jax.Array,  # (n, B, Hq) f32
+) -> jax.Array:
+    """Online-softmax merge of per-rank partials
+    (ref inter-rank combine: flash_decode.py:482-531)."""
+    lse_max = jnp.max(lse_parts, axis=0, keepdims=True)  # (1, B, Hq)
+    safe = jnp.maximum(lse_max, NEG_INF / 2)
+    w = jnp.exp(lse_parts - safe)  # (n, B, Hq)
+    w = jnp.where(lse_parts <= NEG_INF / 2, 0.0, w)
+    denom = jnp.maximum(jnp.sum(w, axis=0), 1e-30)  # (B, Hq)
+    out = jnp.einsum("nbh,nbhd->bhd", w, o_parts) / denom[..., None]
+    return out
+
+
+def sp_flash_decode(
+    q: jax.Array,  # (B, Hq, D)
+    k_shard: jax.Array,  # (B, T_max/n, Hkv, D) per-rank cache shard
+    v_shard: jax.Array,
+    kv_len: jax.Array,  # (B,) GLOBAL valid length
+    axis: str = SP_AXIS,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Distributed decode over a sequence-sharded KV cache; per-device
+    inside shard_map. Rank r owns global positions
+    [r*T_loc, (r+1)*T_loc). Returns (B, Hq, D) in q.dtype, replicated
+    (ref layer: sp_flash_decode_layer.py:44-110)."""
+    me = jax.lax.axis_index(axis)
+    t_loc = k_shard.shape[1]
+    local_len = jnp.clip(kv_len - me * t_loc, 0, t_loc)
+    o, lse = flash_decode_partial(q, k_shard, v_shard, local_len, scale)
+    # small-message exchange of partials (the LL allgather analog)
+    o_parts = jax.lax.all_gather(o, axis)  # (n, B, Hq, D)
+    lse_parts = jax.lax.all_gather(lse, axis)  # (n, B, Hq)
+    out = flash_decode_combine(o_parts, lse_parts)
+    return out.astype(q.dtype)

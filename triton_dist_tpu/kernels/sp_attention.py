@@ -1,0 +1,129 @@
+"""Sequence-parallel attention — ring attention over KV shards.
+
+TPU-native re-design of the reference's SP-AG attention
+(ref: python/triton_dist/kernels/nvidia/sp_ag_attention_intra_node.py:105-427
+and sp_ag_attention_inter_node.py:115-499): there, the KV shards are
+allgathered segment-by-segment on the copy engine while a flash-attention
+consumer waits on per-segment barriers. On TPU the same
+compute/communication overlap is the *ring attention* formulation: KV
+blocks rotate around the ring with `ppermute` while each rank folds the
+arriving block into its online-softmax state — XLA overlaps the collective
+permute with the attention einsums (async collectives over ICI), which is
+exactly the copy-engine/consumer split, without a barrier in sight. The
+rank's own block is folded at step 0 (the reference's rank-offset swizzle:
+zero-wait start).
+
+Memory never exceeds one KV block per step — the blockwise/ring-attention
+long-context property: sequence length scales linearly with the number of
+chips.
+
+Layout: rank r holds Q rows and KV rows [r*S_loc, (r+1)*S_loc) of the
+global sequence (contiguous sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.runtime.init import SP_AXIS
+
+NEG_INF = -1e30
+
+
+def _block_update(q, k, v, q_pos, k_pos, acc, m, l, scale, causal):
+    """Fold one KV block into the online-softmax state (f32).
+
+    q: (B, Sq, Hkv, G, D); k/v: (B, Skv, Hkv, D);
+    acc: (B, Hkv, G, Sq, D); m, l: (B, Hkv, G, Sq, 1)."""
+    s = jnp.einsum("bskgd,btkd->bkgst", q, k) * scale
+    if causal:
+        mask = k_pos[None, None, None, None, :] <= q_pos[:, None, None, :, None]
+        s = jnp.where(mask, s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)  # (B,Hkv,G,Sq,1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.where(m_new <= NEG_INF / 2, 1.0, alpha)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bkgst,btkd->bkgsd", p, v)
+    acc_new = acc * alpha + pv
+    return acc_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,  # (B, Sq_loc, Hq, D)
+    k: jax.Array,  # (B, Skv_loc, Hkv, D)
+    v: jax.Array,  # (B, Skv_loc, Hkv, D)
+    axis: str = SP_AXIS,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel GQA attention; per-device inside shard_map.
+
+    Returns (B, Sq_loc, Hq, D) — each rank's query block attended over the
+    FULL (sharded) sequence (ref consumer contract:
+    sp_ag_attention_intra_node.py:256-427)."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    g = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    q_pos = me * sq + jnp.arange(sq)  # (Sq,); broadcast over batch
+    q_pos = jnp.tile(q_pos[None], (b, 1))
+
+    acc0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq, 1), jnp.float32)
+
+    if n == 1:
+        acc, m, l = _block_update(
+            qf, k.astype(jnp.float32), v.astype(jnp.float32),
+            q_pos, jnp.arange(skv), acc0, m0, l0, scale, causal,
+        )
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        acc, m, l = acc0, m0, l0
+        k_cur, v_cur = k, v
+        # Unrolled (n is static inside shard_map): the last block is folded
+        # WITHOUT a trailing rotate — n-1 hops move n blocks.
+        for s in range(n):
+            chunk = jnp.mod(me - s, n)
+            k_pos = chunk * skv + jnp.arange(skv)
+            acc, m, l = _block_update(
+                qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+                q_pos, k_pos, acc, m, l, scale, causal,
+            )
+            if s < n - 1:
+                # rotate the KV block to the right neighbor (the
+                # per-segment AG push of the reference, expressed as a
+                # collective permute XLA runs async against the einsums)
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bkgsd->bskgd", out).reshape(b, sq, hq, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention_ref(q, k, v, axis: str = SP_AXIS, causal: bool = True,
+                       scale: Optional[float] = None):
+    """Unfused oracle: gather the full KV and run plain GQA attention."""
+    from triton_dist_tpu.layers.attention import gqa_attention
+
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    sq = q.shape[1]
+    k_full = jax.lax.all_gather(k, axis, axis=1, tiled=True)
+    v_full = jax.lax.all_gather(v, axis, axis=1, tiled=True)
+    q_pos = me * sq + jnp.tile(jnp.arange(sq)[None], (q.shape[0], 1))
+    return gqa_attention(
+        q, k_full, v_full, causal=causal, q_positions=q_pos, scale=scale
+    )

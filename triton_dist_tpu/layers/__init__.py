@@ -33,3 +33,9 @@ from triton_dist_tpu.layers.ep_moe import (  # noqa: F401
     ep_moe_fwd,
     ep_moe_ref,
 )
+from triton_dist_tpu.layers.sp_flash_decode import (  # noqa: F401
+    SpDecodeParams,
+    SpDecodeSpec,
+    sp_cache_write,
+    sp_decode_attn_fwd,
+)

@@ -1,0 +1,92 @@
+"""SP flash-decode attention layer — decode over a sequence-sharded cache.
+
+TPU-native re-design of the reference's SpGQAFlashDecodeAttention
+(ref: python/triton_dist/layers/nvidia/sp_flash_decode_layer.py:44-146):
+the KV cache shards by SEQUENCE over the sp axis (scaling decode context
+linearly with chips); each step writes the new token's K/V on the rank
+owning that position, runs the distributed flash-decode, and merges
+partials via the (acc, lse) exchange. QKV/O weights are replicated over sp
+(sp is orthogonal to tp; compose axes for both).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.flash_decode import sp_flash_decode
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.layers.rope import apply_rope
+from triton_dist_tpu.runtime.init import SP_AXIS
+
+
+class SpDecodeParams(NamedTuple):
+    w_qkv: jax.Array  # (H, (Hq+2Hkv)*D) replicated over sp
+    w_o: jax.Array  # (Hq*D, H)
+    q_norm: Optional[jax.Array] = None
+    k_norm: Optional[jax.Array] = None
+
+
+class SpDecodeSpec(NamedTuple):
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+
+
+def sp_cache_write(
+    cache: jax.Array,  # (B, T_loc, Hkv, D) this rank's shard
+    kv_new: jax.Array,  # (B, Hkv, D) this step's K or V
+    pos: jax.Array,  # (B,) global position to write
+    axis: str = SP_AXIS,
+) -> jax.Array:
+    """Write at global `pos`: only the owner rank (pos // T_loc) stores;
+    other ranks drop via an out-of-range index."""
+    me = jax.lax.axis_index(axis)
+    t_loc = cache.shape[1]
+    owner = pos // t_loc
+    local = jnp.where(owner == me, pos - me * t_loc, t_loc)  # t_loc: drop
+    bidx = jnp.arange(cache.shape[0])
+    return cache.at[bidx, local].set(kv_new.astype(cache.dtype), mode="drop")
+
+
+def sp_decode_attn_fwd(
+    x: jax.Array,  # (B, H) replicated over sp — one decode token per seq
+    params: SpDecodeParams,
+    spec: SpDecodeSpec,
+    cos, sin,
+    kv_cache: Tuple[jax.Array, jax.Array],  # per-rank (B,T_loc,Hkv,D) x2
+    kv_len: jax.Array,  # (B,) global length BEFORE this token
+    axis: str = SP_AXIS,
+):
+    """One decode step. Returns (out (B, H) replicated, new (k, v) cache).
+    (ref fwd: sp_flash_decode_layer.py:78-146)."""
+    b, h = x.shape
+    hq, hkv, d = spec.num_q_heads, spec.num_kv_heads, spec.head_dim
+    qkv = jnp.dot(x, params.w_qkv, preferred_element_type=jnp.float32)
+    qkv = qkv.astype(x.dtype)
+    q, k, v = jnp.split(qkv, [hq * d, (hq + hkv) * d], axis=-1)
+    q = q.reshape(b, 1, hq, d)
+    k = k.reshape(b, 1, hkv, d)
+    v = v.reshape(b, 1, hkv, d)
+    if params.q_norm is not None:
+        q = rms_norm(q, params.q_norm)
+    if params.k_norm is not None:
+        k = rms_norm(k, params.k_norm)
+    pos = kv_len[:, None]  # (B, 1) this token's position
+    q = apply_rope(q, cos, sin, pos)
+    k = apply_rope(k, cos, sin, pos)
+
+    k_cache, v_cache = kv_cache
+    k_cache = sp_cache_write(k_cache, k[:, 0], kv_len, axis)
+    v_cache = sp_cache_write(v_cache, v[:, 0], kv_len, axis)
+
+    out = sp_flash_decode(
+        q[:, 0], k_cache, v_cache, kv_len + 1, axis
+    )  # (B, Hq, D)
+    y = jnp.dot(
+        out.reshape(b, hq * d).astype(x.dtype), params.w_o,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return y, (k_cache, v_cache)

@@ -93,6 +93,15 @@ def init_params(
     streams HF weights at init, dense.py:150-167; random init keeps the
     framework dependency-free — `load_hf` maps real checkpoints)."""
     n = int(mesh.shape[axis])
+    assert cfg.num_q_heads % n == 0 and cfg.num_kv_heads % n == 0, (
+        f"num_q_heads={cfg.num_q_heads} and num_kv_heads={cfg.num_kv_heads} "
+        f"must both divide the tp size {n} (pick a smaller tp for this "
+        "config, e.g. Qwen3-30B-A3B with 4 kv heads supports tp<=4)"
+    )
+    assert cfg.vocab_size % n == 0 and (
+        (cfg.moe_intermediate_size if cfg.is_moe else cfg.intermediate_size)
+        % n == 0
+    ), "vocab/intermediate sizes must divide the tp size"
     rng = np.random.default_rng(seed)
     dt = jnp.dtype(cfg.dtype)
     h, d = cfg.hidden_size, cfg.head_dim
