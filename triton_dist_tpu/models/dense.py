@@ -87,11 +87,16 @@ def cache_specs(axis: str = TP_AXIS, batch_axis: Optional[str] = None):
 
 
 def init_params(
-    cfg: ModelConfig, mesh, seed: int = 0, axis: str = TP_AXIS
+    cfg: ModelConfig, mesh, seed: int = 0, axis: str = TP_AXIS,
+    fast: bool = False,
 ) -> DenseLLMParams:
     """Random-init global arrays laid out for shard_map (the reference
     streams HF weights at init, dense.py:150-167; random init keeps the
-    framework dependency-free — `load_hf` maps real checkpoints)."""
+    framework dependency-free — `load_hf` maps real checkpoints).
+
+    fast=True draws on-device with jax.random instead of host numpy —
+    O(seconds) instead of O(minutes) at multi-billion-param scale; use it
+    whenever the exact host RNG stream doesn't matter (benchmarks)."""
     n = int(mesh.shape[axis])
     assert cfg.num_q_heads % n == 0 and cfg.num_kv_heads % n == 0, (
         f"num_q_heads={cfg.num_q_heads} and num_kv_heads={cfg.num_kv_heads} "
@@ -110,8 +115,16 @@ def init_params(
     v_l = cfg.vocab_size // n
     L = cfg.num_layers
 
-    def mk(shape, scale=0.02):
-        return jnp.asarray(rng.standard_normal(shape) * scale, dt)
+    if fast:
+        key_box = [jax.random.PRNGKey(seed)]
+
+        def mk(shape, scale=0.02):
+            key_box[0], sub = jax.random.split(key_box[0])
+            return (jax.random.normal(sub, shape, jnp.float32) * scale
+                    ).astype(dt)
+    else:
+        def mk(shape, scale=0.02):
+            return jnp.asarray(rng.standard_normal(shape) * scale, dt)
 
     if cfg.is_moe:
         e = cfg.num_experts
@@ -237,8 +250,10 @@ def forward(
     if not return_full_logits:
         x = x[:, -1:]
     head = params.lm_head[0]  # strip n dim
+    # bf16 operands + f32 accumulation: avoids materialising an f32 copy
+    # of the (H, V/n) head shard (the MXU accumulates in f32 natively).
     logits = jnp.einsum(
-        "bsh,hv->bsv", x.astype(jnp.float32), head.astype(jnp.float32)
+        "bsh,hv->bsv", x, head, preferred_element_type=jnp.float32
     )
     logits = jax.lax.all_gather(logits, axis, axis=2, tiled=True)  # (B,S,V)
     if not return_full_logits:

@@ -59,6 +59,7 @@ class Engine:
         max_len: Optional[int] = None,
         batch_axis: Optional[str] = None,
         donate_cache: bool = True,
+        fast_init: bool = False,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -66,7 +67,8 @@ class Engine:
         self.batch_axis = batch_axis
         self.max_len = max_len or cfg.max_positions
         self.params = (
-            params if params is not None else init_params(cfg, mesh, seed, axis)
+            params if params is not None
+            else init_params(cfg, mesh, seed, axis, fast=fast_init)
         )
         n = int(mesh.shape[axis])
         self._hkv_loc = cfg.num_kv_heads // n
