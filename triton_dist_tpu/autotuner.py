@@ -66,14 +66,17 @@ def _agree_on_hit(hit: Optional[TuneResult]) -> Optional[TuneResult]:
     through to measuring together."""
     if jax.process_count() <= 1:
         return hit
+    import zlib
+
+    import numpy as np
     from jax.experimental import multihost_utils
 
-    mine = repr(hit.config) if hit is not None else ""
-    theirs = multihost_utils.process_allgather(mine, tiled=False)
-    views = {str(v) for v in (
-        theirs.tolist() if hasattr(theirs, "tolist") else theirs
-    )}
-    return hit if views == {mine} and mine else None
+    # fixed-size numeric encoding: process_allgather cannot ship strings
+    mine = (zlib.crc32(repr(hit.config).encode()) + 1) if hit else 0
+    theirs = np.asarray(multihost_utils.process_allgather(
+        np.asarray(mine, dtype=np.int64)
+    )).ravel()
+    return hit if mine and (theirs == mine).all() else None
 
 
 class ContextualAutotuner:

@@ -1,0 +1,86 @@
+"""ctypes loader/builder for the native scheduler library.
+
+The reference ships native planning code built by its cmake tree
+(ref: csrc/CMakeLists.txt, python/setup.py:54-146); here one translation
+unit is compiled on demand with g++ into the package build dir (pybind11
+is not available in this environment — the C ABI + ctypes is the binding).
+Every native entry point has a pure-Python mirror in mega/scheduler.py;
+`load()` returning None silently selects it (e.g. no toolchain).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc", "scheduler.cc")
+_OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "csrc", "build")
+_LIB = os.path.join(_OUT_DIR, "libtdtsched.so")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    src = os.path.abspath(_SRC)
+    tmp = _LIB + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native lib, building it on first use; None => Python fallback."""
+    global _cached, _failed
+    if _cached is not None or _failed:
+        return _cached
+    with _lock:
+        if _cached is not None or _failed:
+            return _cached
+        if os.environ.get("TDT_NO_NATIVE") == "1":
+            _failed = True
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not _build():
+                _failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _failed = True
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.tdt_schedule.restype = ctypes.c_int
+        lib.tdt_schedule.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, f64p,
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+        ]
+        lib.tdt_watermarks.restype = ctypes.c_int
+        lib.tdt_watermarks.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i32p,
+            ctypes.c_int32, i32p,
+        ]
+        lib.tdt_plan_slots.restype = ctypes.c_int
+        lib.tdt_plan_slots.argtypes = [
+            ctypes.c_int32, i32p, i32p, u8p, i32p,
+        ]
+        _cached = lib
+        return _cached

@@ -1,0 +1,206 @@
+"""ModelBuilder — the megakernel's host-side op API.
+
+TPU-native re-design of the reference's ModelBuilder
+(ref: python/triton_dist/mega_triton_kernel/models/model_builder.py:83-408
+`make_qkv_proj/make_attn/make_allreduce/...` building tasks, :372 compile,
+:391 run). Ops append Tasks to a Graph; each op carries a branch_key =
+(op kind, static shape tuple) — the analog of the reference's CodeGenKey
+specialization — so all layers with one shape share one generated switch
+branch and the layer index rides in the dynamic args. Costs come from the
+analytic perf model so the (multi-core) scheduler can load-balance by
+critical path.
+
+Dynamic-arg conventions per op (queue row = [branch, a0..a5]):
+  matmul        [layer, src_buf, dst_buf]
+  rms_norm      [norm_row, src_buf, dst_buf]
+  silu_mul      [src_buf, dst_buf]
+  add           [a_buf, b_buf, dst_buf]
+  allreduce_add [partial_buf, residual_buf, dst_buf, parity]
+  attention     [layer, qkv_buf, dst_buf, k_new_buf, v_new_buf]
+  barrier       []
+Buffer-id args are rewritten to workspace slots at compile time
+(Task.buf_args marks their positions).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from triton_dist_tpu.mega.core import BufferHandle, Graph, Task
+from triton_dist_tpu.perf_model import (
+    detect_chip,
+    estimate_ar_ms,
+    estimate_gemm_ms,
+)
+
+
+class ModelBuilder:
+    """Builds the task graph of one decode step (batch rows × op widths).
+
+    `weights` maps logical weight names (w_qkv, w_o, w_gate_up, w_down) to
+    kernel input indices at compile time; the builder only records names.
+    """
+
+    def __init__(self, batch: int, axis: str = "tp", world: int = 1):
+        self.graph = Graph(batch)
+        self.batch = batch
+        self.axis = axis
+        self.world = world
+        self._chip = detect_chip()
+        self._ar_count = 0
+
+    # -- buffers -------------------------------------------------------------
+
+    def buffer(self, width: int, name: str = "",
+               pinned: bool = False) -> BufferHandle:
+        return self.graph.buffer(width, name, pinned)
+
+    # -- ops -----------------------------------------------------------------
+
+    def make_barrier(self) -> Optional[Task]:
+        """Entry barrier: no remote DMA may land in a peer that has not
+        entered the kernel (the ref's barrier task / BarrierAllContext,
+        mega_triton_kernel/kernels/barrier.py)."""
+        if self.world <= 1:
+            return None
+        return self.graph.add_task(
+            "barrier", ("barrier", self.axis, self.world), [0, 0, 0],
+            reads=[], writes=[], cost=0.01, tag="barrier",
+        )
+
+    def make_matmul(
+        self,
+        wname: str,
+        layer: int,
+        src: BufferHandle,
+        k: int,
+        n_cols: int,
+        dst: Optional[BufferHandle] = None,
+        tag: str = "",
+    ) -> BufferHandle:
+        """dst(B, n_cols) = src(B, k) @ weights[wname][layer] (k, n_cols).
+        (ref: make_qkv_proj/make_o_proj/make_mlp_fc, model_builder.py:189-300)
+        """
+        dst = dst or self.buffer(n_cols, tag or wname)
+        self.graph.add_task(
+            "matmul", ("matmul", wname, k, n_cols),
+            [layer, src.id, dst.id],
+            reads=[src], writes=[dst],
+            cost=estimate_gemm_ms(self.batch, n_cols, k, chip=self._chip),
+            tag=tag or f"{wname}[{layer}]", buf_args=(1, 2),
+        )
+        return dst
+
+    def make_rms_norm(
+        self,
+        norm_row: int,
+        src: BufferHandle,
+        width: int,
+        eps: float,
+        dst: Optional[BufferHandle] = None,
+        tag: str = "",
+    ) -> BufferHandle:
+        """dst = rms_norm(src) * norms[norm_row] over `width` columns
+        (ref: make_rms_norm, model_builder.py:189-368)."""
+        dst = dst or self.buffer(width, tag or "rmsnorm")
+        self.graph.add_task(
+            "rms_norm", ("rms_norm", width, eps),
+            [norm_row, src.id, dst.id],
+            reads=[src], writes=[dst], cost=0.02,
+            tag=tag or f"rms[{norm_row}]", buf_args=(1, 2),
+        )
+        return dst
+
+    def make_silu_mul(
+        self, src: BufferHandle, inter: int,
+        dst: Optional[BufferHandle] = None, tag: str = "",
+    ) -> BufferHandle:
+        """dst(B, inter) = silu(src[:, :inter]) * src[:, inter:2*inter]
+        (ref: make_activation, mega kernels/activation.py)."""
+        dst = dst or self.buffer(inter, tag or "silu_mul")
+        self.graph.add_task(
+            "silu_mul", ("silu_mul", inter), [src.id, dst.id, 0],
+            reads=[src], writes=[dst], cost=0.02,
+            tag=tag or "silu_mul", buf_args=(0, 1),
+        )
+        return dst
+
+    def make_add(
+        self, a: BufferHandle, b: BufferHandle, width: int,
+        dst: Optional[BufferHandle] = None, tag: str = "",
+    ) -> BufferHandle:
+        """dst = a + b (residual adds; ref: make_elementwise)."""
+        dst = dst or self.buffer(width, tag or "add")
+        self.graph.add_task(
+            "add", ("add", width), [a.id, b.id, dst.id],
+            reads=[a, b], writes=[dst], cost=0.01,
+            tag=tag or "add", buf_args=(0, 1, 2),
+        )
+        return dst
+
+    def make_allreduce_add(
+        self,
+        partial: BufferHandle,
+        residual: BufferHandle,
+        width: int,
+        dst: Optional[BufferHandle] = None,
+        tag: str = "",
+    ) -> BufferHandle:
+        """dst = all_reduce(partial, axis) + residual — the TP row-parallel
+        epilogue fused with the residual add (ref: make_allreduce,
+        model_builder.py:331-351 + mega kernels/allreduce.py multimem AR).
+        Mailbox reuse across calls is parity-double-buffered; flow control
+        is the recv-wait itself (a device cannot start AR k+2 before every
+        peer finished AR k — see kernel._allreduce_branch)."""
+        dst = dst or self.buffer(width, tag or "ar")
+        parity = self._ar_count % 2
+        self._ar_count += 1
+        self.graph.add_task(
+            "allreduce_add",
+            ("allreduce_add", width, self.axis, self.world),
+            [partial.id, residual.id, dst.id, parity],
+            reads=[partial, residual], writes=[dst],
+            cost=estimate_ar_ms(
+                width * self.batch * 2, self.world, self._chip
+            ) + 0.01,
+            tag=tag or f"ar[{self._ar_count - 1}]", buf_args=(0, 1, 2),
+        )
+        return dst
+
+    def make_attention(
+        self,
+        layer: int,
+        qkv: BufferHandle,
+        hq_l: int,
+        hkv_l: int,
+        head_dim: int,
+        s_max: int,
+        eps: float,
+        use_qk_norm: bool,
+        q_norm_base: int = 0,
+        k_norm_base: int = 0,
+        dst: Optional[BufferHandle] = None,
+        tag: str = "",
+    ) -> Tuple[BufferHandle, BufferHandle, BufferHandle]:
+        """Decode attention: qk-norm + rope + GQA over the cached prefix,
+        with the new token's k/v folded into the softmax in-register
+        (ref: make_attn → paged flash decode task,
+        model_builder.py:240-287). Returns (attn_out, k_new, v_new); the
+        runner scatters k_new/v_new into the cache outside the kernel
+        (see kernel.py module docstring). q/k_norm_base: row offsets of
+        the per-layer qk-norm vectors in the stacked norms array."""
+        dst = dst or self.buffer(hq_l * head_dim, tag or "attn")
+        kn = self.buffer(hkv_l * head_dim, f"k_new[{layer}]", pinned=True)
+        vn = self.buffer(hkv_l * head_dim, f"v_new[{layer}]", pinned=True)
+        self.graph.add_task(
+            "attention",
+            ("attention", hq_l, hkv_l, head_dim, s_max, eps, use_qk_norm,
+             q_norm_base, k_norm_base),
+            [layer, qkv.id, dst.id, kn.id, vn.id],
+            reads=[qkv], writes=[dst, kn, vn],
+            cost=estimate_gemm_ms(
+                self.batch * hq_l, s_max, head_dim, chip=self._chip
+            ) * 2 + 0.03,
+            tag=tag or f"attn[{layer}]", buf_args=(1, 2, 3, 4),
+        )
+        return dst, kn, vn

@@ -1,0 +1,149 @@
+"""Megakernel task graph: buffers, tasks, dependency tracking.
+
+TPU-native re-design of the reference's megakernel task framework
+(ref: python/triton_dist/mega_triton_kernel/core/task_base.py:36-220 —
+CodeGenKey / TaskDependency / int-tuple task encoding — and
+core/builder.py:33-64). The reference encodes raw tensor pointers into
+uint32 work-queue rows; TPU kernels have no pointers, so activations live
+in one flat HBM workspace of uniform B-row slots and tasks carry *slot
+indices* (plus layer ids and op args) in their int32 rows. Dependencies
+are derived from buffer def/use (the ref builds TaskDependency tile
+ranges; at decode shapes every op is a single tile, so task == tile and
+the dependency is the whole buffer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferHandle:
+    """One logical activation tensor: a B-row × width stripe of the
+    workspace. `slot` is assigned by the planner at compile time."""
+
+    id: int
+    width: int
+    name: str = ""
+
+
+@dataclasses.dataclass
+class Task:
+    """One schedulable unit (op == single tile at decode shapes).
+
+    branch_key identifies the generated switch branch (the reference's
+    CodeGenKey(task_type, layer_id, task_id) — ours keys on the op kind
+    plus its *static* config, so all layers sharing a shape share one
+    branch and layer_id moves into the dynamic args)."""
+
+    id: int
+    op: str
+    branch_key: Hashable
+    args: List[int]                 # dynamic scalars for the queue row
+    reads: List[int]                # buffer ids
+    writes: List[int]               # buffer ids
+    cost: float = 1.0               # perf-model estimate for the scheduler
+    tag: str = ""
+    # arg positions holding buffer ids, rewritten to workspace slots at
+    # compile time (queue rows must carry slots, not graph buffer ids)
+    buf_args: Tuple[int, ...] = ()
+
+
+class Graph:
+    """Append-only op graph with last-writer/reader dependency tracking
+    (the reference tracks deps through its tensor wrappers;
+    model_builder.py:160-175)."""
+
+    def __init__(self, batch: int):
+        self.batch = batch
+        self.buffers: List[BufferHandle] = []
+        self.tasks: List[Task] = []
+        self._writer: Dict[int, int] = {}        # buf -> task that wrote it
+        self._readers: Dict[int, List[int]] = {}  # buf -> tasks that read it
+        self._edges: set = set()
+        self.edges: List[Tuple[int, int]] = []
+        self.pinned: Dict[int, bool] = {}
+        # last barrier task id: all tasks added after a barrier depend on
+        # it (otherwise the critical-path scheduler, seeing no edges,
+        # would sink the zero-cost barrier to the END of the queue — and
+        # remote DMA could land in a peer that has not entered the kernel)
+        self.barrier: int = -1
+
+    # -- buffers -------------------------------------------------------------
+
+    def buffer(self, width: int, name: str = "",
+               pinned: bool = False) -> BufferHandle:
+        """New logical activation buffer. pinned=True gives it a dedicated
+        workspace slot (kernel I/O: the planner must not reuse it)."""
+        b = BufferHandle(len(self.buffers), int(width), name)
+        self.buffers.append(b)
+        self.pinned[b.id] = pinned
+        return b
+
+    # -- tasks ---------------------------------------------------------------
+
+    def _edge(self, src: int, dst: int) -> None:
+        if src != dst and (src, dst) not in self._edges:
+            self._edges.add((src, dst))
+            self.edges.append((src, dst))
+
+    def add_task(
+        self,
+        op: str,
+        branch_key: Hashable,
+        args: Sequence[int],
+        reads: Sequence[BufferHandle],
+        writes: Sequence[BufferHandle],
+        cost: float = 1.0,
+        tag: str = "",
+        buf_args: Sequence[int] = (),
+        extra_deps: Sequence["Task"] = (),
+    ) -> Task:
+        t = Task(len(self.tasks), op, branch_key, list(args),
+                 [b.id for b in reads], [b.id for b in writes],
+                 cost, tag, tuple(buf_args))
+        for b in t.reads:
+            w = self._writer.get(b)
+            if w is not None:
+                self._edge(w, t.id)          # RAW
+            self._readers.setdefault(b, []).append(t.id)
+        for b in t.writes:
+            w = self._writer.get(b)
+            if w is not None:
+                self._edge(w, t.id)          # WAW
+            for r in self._readers.get(b, ()):
+                self._edge(r, t.id)          # WAR
+            self._writer[b] = t.id
+            self._readers[b] = []
+        for d in extra_deps:
+            self._edge(d.id, t.id)
+        if op == "barrier":
+            self.barrier = t.id
+        elif self.barrier >= 0:
+            self._edge(self.barrier, t.id)
+        self.tasks.append(t)
+        return t
+
+    # -- liveness (for the slot planner) --------------------------------------
+
+    def liveness(self, order: Sequence[int]) -> Tuple[List[int], List[int]]:
+        """(def_time, last_use_time) per buffer, in global schedule order.
+        Buffers never defined by a task (external inputs) get def 0;
+        buffers never read after their last write keep last=def."""
+        time_of = {t: i for i, t in enumerate(order)}
+        ndef = [0] * len(self.buffers)
+        last = [0] * len(self.buffers)
+        seen_def = [False] * len(self.buffers)
+        for t in self.tasks:
+            ti = time_of[t.id]
+            for b in t.writes:
+                if not seen_def[b]:
+                    ndef[b] = ti
+                    seen_def[b] = True
+                if ti > last[b]:
+                    last[b] = ti
+            for b in t.reads:
+                if ti > last[b]:
+                    last[b] = ti
+        return ndef, last

@@ -1,0 +1,654 @@
+"""The persistent TPU megakernel: one Pallas kernel runs the whole task
+queue of a decode step.
+
+TPU-native re-design of the reference's generated MEGA_TRITON_KERNEL
+(ref: python/triton_dist/mega_triton_kernel/core/code_generator.py:31-175
+and kernels/task_context.py:92-140). The mapping:
+
+  NUM_SMS persistent blocks      -> one Pallas grid over the task queue
+                                    (a TPU chip has 1-2 TensorCores, not
+                                    132 SMs; see mega/scheduler.py)
+  uint32 work-queue tensor       -> scalar-prefetched int32 queue rows
+  generated if/elif on task_type -> lax.switch over branch closures built
+                                    at trace time, one per distinct
+                                    (op, static-config) key — trace-time
+                                    specialization IS the codegen step
+  tensor pointers in the row     -> workspace slot ids (flat HBM
+                                    activation arena planned by
+                                    tdt_plan_slots) + layer ids indexing
+                                    stacked weight arrays
+  scoreboard signal table        -> same-core program order (single-core
+                                    queues are topologically sorted);
+                                    cross-chip AR uses remote DMA delivery
+                                    semaphores; multi-core watermark
+                                    execution is planned by the scheduler
+                                    but not yet lowered (v5e/v6e chips are
+                                    single-TensorCore)
+  in-kernel multimem allreduce   -> one-shot mailbox AR over ICI remote
+                                    DMA, parity-double-buffered across
+                                    decode steps (ref mega
+                                    kernels/allreduce.py)
+
+Weight loads are double-buffered against the MXU inside the matmul branch
+(the reference's prefetch task analog, mega kernels/prefetch.py).
+
+Layout notes forced by Mosaic HBM tiling (slices along the second-minor
+dim must be sublane-aligned): workspace slots are PB-row stripes with
+PB = round_up(batch, sublane); the decode KV cache is (L, Hkv, B, S, D)
+so per-head reads slice only leading dims; and the attention task does
+NOT append to the cache in-kernel — it emits the rope'd k/v rows as
+ordinary workspace outputs, folds the new element into its own softmax,
+and the caller scatters them into the cache with one XLA
+dynamic_update_slice fused into the same jit (the ref's paged KV append,
+mega_triton_kernel/models/paged_kv_cache.py, is a device-side scatter for
+the same reason: the cache write is not on the kernel's critical path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import (
+    compiler_params,
+    min_tile,
+    next_collective_id,
+    round_up,
+    tpu_call,
+)
+from triton_dist_tpu.mega.core import Graph
+from triton_dist_tpu.mega.scheduler import Schedule
+
+ROW = 7  # queue row: [branch, a0..a5]
+
+
+def _fit_tile(n: int, cap: int = 512) -> int:
+    """Largest divisor of n that is <= cap, preferring lane multiples."""
+    best = 1
+    for t in range(min(cap, n), 0, -1):
+        if n % t == 0:
+            if t % 128 == 0 or t == n:
+                return t
+            if best == 1:
+                best = t
+    return best
+
+
+@dataclasses.dataclass
+class _Env:
+    """Refs + static dims visible to branch builders."""
+
+    dtype: Any
+    batch: int     # logical batch rows
+    pb: int        # sublane-padded stripe height of one workspace slot
+    wmax: int
+    pos: Any = None
+    ws: Any = None
+    weights: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    norms: Any = None
+    rope_cs: Any = None
+    k_cache: Any = None
+    v_cache: Any = None
+    vin: Any = None
+    vin2: Any = None
+    vout: Any = None
+    vw: Any = None
+    vkv: Any = None
+    vrope: Any = None
+    vnq: Any = None
+    vnk: Any = None
+    mailbox: Any = None
+    ld1: Any = None
+    ld2: Any = None
+    st: Any = None
+    wsems: Any = None
+    kvsem: Any = None
+    send: Any = None
+    recv: Any = None
+
+    def ws_rows(self, slot, width):
+        return self.ws.at[pl.ds(slot * self.pb, self.pb), pl.ds(0, width)]
+
+
+# -- branch builders (one per op kind; key carries the static config) --------
+
+
+def _matmul_branch(key, env: _Env):
+    _, wname, K, N = key
+    TN = _fit_tile(N)
+    nt = N // TN
+    w_ref = env.weights[wname]
+
+    def wcopy(layer, j, slot):
+        return pltpu.make_async_copy(
+            w_ref.at[layer, :, pl.ds(j * TN, TN)],
+            env.vw.at[slot, pl.ds(0, K), pl.ds(0, TN)],
+            env.wsems.at[slot],
+        )
+
+    def body(args):
+        layer, src, dst = args[0], args[1], args[2]
+        cp_in = pltpu.make_async_copy(
+            env.ws_rows(src, K), env.vin.at[:, pl.ds(0, K)], env.ld1
+        )
+        cp_in.start()
+        wcopy(layer, 0, 0).start()
+        cp_in.wait()
+        a = env.vin[:, :K]
+        for j in range(nt):
+            if j + 1 < nt:
+                wcopy(layer, j + 1, (j + 1) % 2).start()
+            wcopy(layer, j, j % 2).wait()
+            acc = jax.lax.dot_general(
+                a, env.vw[j % 2, :K, :TN], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            env.vout[:, j * TN:(j + 1) * TN] = acc.astype(env.dtype)
+        st = pltpu.make_async_copy(
+            env.vout.at[:, pl.ds(0, N)], env.ws_rows(dst, N), env.st
+        )
+        st.start()
+        st.wait()
+
+    return body
+
+
+def _rms_norm_branch(key, env: _Env):
+    _, W, eps = key
+
+    def body(args):
+        nrow, src, dst = args[0], args[1], args[2]
+        cp_in = pltpu.make_async_copy(
+            env.ws_rows(src, W), env.vin.at[:, pl.ds(0, W)], env.ld1
+        )
+        # norms ship 8-row-striped (row i at 8*i): single-row dynamic
+        # slices are not tiling-aligned on Mosaic, 8-row stripes are
+        cp_w = pltpu.make_async_copy(
+            env.norms.at[pl.ds(nrow * 8, 8)], env.vnq, env.ld2
+        )
+        cp_in.start()
+        cp_w.start()
+        cp_in.wait()
+        cp_w.wait()
+        x = env.vin[:, :W].astype(jnp.float32)
+        w = env.vnq[0, :W].astype(jnp.float32)
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+        y = x * jax.lax.rsqrt(var + eps) * w[None, :]
+        env.vout[:, :W] = y.astype(env.dtype)
+        st = pltpu.make_async_copy(
+            env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
+        )
+        st.start()
+        st.wait()
+
+    return body
+
+
+def _silu_mul_branch(key, env: _Env):
+    _, I = key
+
+    def body(args):
+        src, dst = args[0], args[1]
+        cp_in = pltpu.make_async_copy(
+            env.ws_rows(src, 2 * I), env.vin.at[:, pl.ds(0, 2 * I)], env.ld1
+        )
+        cp_in.start()
+        cp_in.wait()
+        g = env.vin[:, :I].astype(jnp.float32)
+        u = env.vin[:, I:2 * I].astype(jnp.float32)
+        y = g * jax.nn.sigmoid(g) * u
+        env.vout[:, :I] = y.astype(env.dtype)
+        st = pltpu.make_async_copy(
+            env.vout.at[:, pl.ds(0, I)], env.ws_rows(dst, I), env.st
+        )
+        st.start()
+        st.wait()
+
+    return body
+
+
+def _add_branch(key, env: _Env):
+    _, W = key
+
+    def body(args):
+        asrc, bsrc, dst = args[0], args[1], args[2]
+        cp_a = pltpu.make_async_copy(
+            env.ws_rows(asrc, W), env.vin.at[:, pl.ds(0, W)], env.ld1
+        )
+        cp_b = pltpu.make_async_copy(
+            env.ws_rows(bsrc, W),
+            env.vin2.at[pl.ds(0, env.pb), pl.ds(0, W)], env.ld2,
+        )
+        cp_a.start()
+        cp_b.start()
+        cp_a.wait()
+        cp_b.wait()
+        env.vout[:, :W] = env.vin[:, :W] + env.vin2[:env.pb, :W]
+        st = pltpu.make_async_copy(
+            env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
+        )
+        st.start()
+        st.wait()
+
+    return body
+
+
+def _barrier_branch(key, env: _Env):
+    _, axis, n = key
+
+    def body(args):
+        shmem.barrier_all(axis)
+
+    return body
+
+
+def _allreduce_add_branch(key, env: _Env):
+    """One-shot mailbox AR + residual add (ref mega kernels/allreduce.py
+    multimem ld_reduce analog; see make_allreduce_add for the parity
+    flow-control argument)."""
+    _, W, axis, n = key
+
+    def body(args):
+        src, res, dst, parity = args[0], args[1], args[2], args[3]
+        pb = env.pb
+        cp_res = pltpu.make_async_copy(
+            env.ws_rows(res, W),
+            env.vin2.at[pl.ds(0, pb), pl.ds(0, W)], env.ld2,
+        )
+        cp_res.start()
+        if n > 1:
+            me = jax.lax.axis_index(axis)
+            cp_loc = pltpu.make_async_copy(
+                env.ws_rows(src, W),
+                env.mailbox.at[parity, me, :, pl.ds(0, W)],
+                env.ld1,
+            )
+            cp_loc.start()
+            handles = []
+            for i in range(1, n):
+                peer = jax.lax.rem(me + i, n)
+                h = shmem.putmem_nbi(
+                    env.mailbox.at[parity, me, :, pl.ds(0, W)],
+                    env.ws_rows(src, W),
+                    env.send, env.recv, peer, axis,
+                )
+                handles.append(h)
+            cp_loc.wait()
+            for h in handles:
+                h.wait()
+            acc = env.mailbox[parity, 0, :, :W].astype(jnp.float32)
+            for r in range(1, n):
+                acc = acc + env.mailbox[parity, r, :, :W].astype(jnp.float32)
+        else:
+            cp_loc = pltpu.make_async_copy(
+                env.ws_rows(src, W), env.vin.at[:, pl.ds(0, W)], env.ld1
+            )
+            cp_loc.start()
+            cp_loc.wait()
+            acc = env.vin[:, :W].astype(jnp.float32)
+        cp_res.wait()
+        acc = acc + env.vin2[:env.pb, :W].astype(jnp.float32)
+        env.vout[:, :W] = acc.astype(env.dtype)
+        st = pltpu.make_async_copy(
+            env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
+        )
+        st.start()
+        st.wait()
+
+    return body
+
+
+def _attention_branch(key, env: _Env):
+    """qk-norm + rope + GQA decode (ref: mega kernels/flash_attn.py page
+    attention task). The new token's k/v rows are written to workspace
+    slots and folded into the softmax directly; the caller scatters them
+    into the cache (see module docstring)."""
+    _, hq_l, hkv_l, D, SMAX, eps, use_qk_norm, q_base, k_base = key
+    B = env.batch
+    half = D // 2
+    g = hq_l // hkv_l
+    scale = D ** -0.5
+    kw = hkv_l * D
+    hqd = hq_l * D
+    WQKV = hqd + 2 * kw
+    # lane-aligned staging layout (DMA widths padded to 128; readers only
+    # consume the true kw/hqd prefixes of the destination slots)
+    kwp = round_up(kw, 128)
+    hqdp = round_up(hqd, 128)
+
+    def rope(x, c, s):
+        # x (B, h, D), c/s (B, half) f32; half-split convention
+        x1 = x[..., :half]
+        x2 = x[..., half:]
+        cb = c[:B, None, :]
+        sb = s[:B, None, :]
+        return jnp.concatenate([x1 * cb - x2 * sb, x2 * cb + x1 * sb],
+                               axis=-1)
+
+    def rmsn(x, w):
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(var + eps) * w[None, None, :]
+
+    def body(args):
+        layer, src, dst, kn_dst, vn_dst = (
+            args[0], args[1], args[2], args[3], args[4]
+        )
+        cp_in = pltpu.make_async_copy(
+            env.ws_rows(src, WQKV), env.vin.at[:, pl.ds(0, WQKV)], env.ld1
+        )
+        cp_in.start()
+        if use_qk_norm:
+            cp_qn = pltpu.make_async_copy(
+                env.norms.at[pl.ds((q_base + layer) * 8, 8)], env.vnq,
+                env.ld2,
+            )
+            cp_kn = pltpu.make_async_copy(
+                env.norms.at[pl.ds((k_base + layer) * 8, 8)], env.vnk,
+                env.kvsem,
+            )
+            cp_qn.start()
+            cp_kn.start()
+        rope_cps = []
+        for b in range(B):
+            cp = pltpu.make_async_copy(
+                env.rope_cs.at[pl.ds(env.pos[b] * 8, 8)],
+                env.vrope.at[b],
+                env.wsems.at[b % 2],
+            )
+            cp.start()
+            rope_cps.append(cp)
+        cp_in.wait()
+        if use_qk_norm:
+            cp_qn.wait()
+            cp_kn.wait()
+        for cp in rope_cps:
+            cp.wait()
+
+        # full-PB loads/stores only: Mosaic rejects sub-sublane ref slices;
+        # value-level slicing to the B live rows is free vreg selection
+        qkv_full = env.vin[:, :WQKV].astype(jnp.float32)
+        qkv = qkv_full[:B]
+        q = qkv[:, :hqd].reshape(B, hq_l, D)
+        kn = qkv[:, hqd:hqd + kw].reshape(B, hkv_l, D)
+        vn = qkv[:, hqd + kw:WQKV].reshape(B, hkv_l, D)
+        if use_qk_norm:
+            q = rmsn(q, env.vnq[0, :D].astype(jnp.float32))
+            kn = rmsn(kn, env.vnk[0, :D].astype(jnp.float32))
+        cs_rows = env.vrope[:, 0, :]  # (B, D)
+        c = cs_rows[:, :half]
+        s = cs_rows[:, half:D]
+        q = rope(q, c, s)
+        kn = rope(kn, c, s)
+
+        def pad_rows(v):
+            pb = env.pb
+            if v.shape[0] == pb:
+                return v
+            return jnp.concatenate(
+                [v, jnp.zeros((pb - v.shape[0], v.shape[1]), v.dtype)], 0
+            )
+
+        # stage: [0,hqdp) attention out · then k_new · then v_new
+        env.vout[:, hqdp:hqdp + kw] = pad_rows(
+            kn.reshape(B, kw).astype(env.dtype))
+        env.vout[:, hqdp + kwp:hqdp + kwp + kw] = pad_rows(
+            vn.reshape(B, kw).astype(env.dtype))
+
+        out_rows = []  # per-b (1, hqd) attention outputs, kv-head-major
+        for h in range(hkv_l):
+            cp_k = pltpu.make_async_copy(
+                env.k_cache.at[layer, h], env.vkv.at[0], env.ld1
+            )
+            cp_v = pltpu.make_async_copy(
+                env.v_cache.at[layer, h], env.vkv.at[1], env.ld2
+            )
+            cp_k.start()
+            cp_v.start()
+            cp_k.wait()
+            cp_v.wait()
+            kf = env.vkv[0].astype(jnp.float32)  # (B, SMAX, D)
+            vf = env.vkv[1].astype(jnp.float32)
+            for b in range(B):
+                qb = q[b, h * g:(h + 1) * g] * scale  # (g, D)
+                lg = jax.lax.dot_general(
+                    qb, kf[b], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (g, SMAX) over the cached prefix
+                spos = jax.lax.broadcasted_iota(jnp.int32, (g, SMAX), 1)
+                lg = jnp.where(spos < env.pos[b], lg, -1e30)
+                lg_new = jnp.sum(qb * kn[b, h][None, :], axis=-1,
+                                 keepdims=True)  # (g, 1)
+                m = jnp.maximum(jnp.max(lg, axis=-1, keepdims=True),
+                                lg_new)
+                p_ = jnp.exp(lg - m)
+                p_new = jnp.exp(lg_new - m)
+                denom = jnp.sum(p_, axis=-1, keepdims=True) + p_new
+                ob = jax.lax.dot_general(
+                    p_, vf[b], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (g, D)
+                ob = (ob + p_new * vn[b, h][None, :]) / denom
+                if h == 0:
+                    out_rows.append([ob.reshape(1, g * D)])
+                else:
+                    out_rows[b].append(ob.reshape(1, g * D))
+
+        out = jnp.concatenate(
+            [jnp.concatenate(per_b, axis=1) for per_b in out_rows], axis=0
+        )  # (B, hqd)
+        env.vout[:, :hqd] = pad_rows(out.astype(env.dtype))
+
+        cps = [
+            pltpu.make_async_copy(
+                env.vout.at[:, pl.ds(0, hqdp)], env.ws_rows(dst, hqdp),
+                env.st,
+            ),
+            pltpu.make_async_copy(
+                env.vout.at[:, pl.ds(hqdp, kwp)],
+                env.ws_rows(kn_dst, kwp), env.wsems.at[0],
+            ),
+            pltpu.make_async_copy(
+                env.vout.at[:, pl.ds(hqdp + kwp, kwp)],
+                env.ws_rows(vn_dst, kwp), env.wsems.at[1],
+            ),
+        ]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+    return body
+
+
+_BRANCH_BUILDERS: Dict[str, Callable] = {
+    "matmul": _matmul_branch,
+    "rms_norm": _rms_norm_branch,
+    "silu_mul": _silu_mul_branch,
+    "add": _add_branch,
+    "allreduce_add": _allreduce_add_branch,
+    "attention": _attention_branch,
+    "barrier": _barrier_branch,
+}
+
+
+@dataclasses.dataclass
+class CompiledMega:
+    """The compiled megakernel + its static plan."""
+
+    run: Callable  # (pos, ws, weights_dict, norms, rope_cs, k, v) -> ws
+    queue: np.ndarray  # (n_tasks, ROW) int32
+    n_slots: int
+    pb: int        # stripe height (sublane-padded batch)
+    wmax: int
+    norm_width: int  # required minor dim of the stacked norms array
+    branch_keys: List[Any]
+    weight_names: List[str]
+
+    def workspace(self, dtype) -> jnp.ndarray:
+        return jnp.zeros((self.n_slots * self.pb, self.wmax), dtype)
+
+    def slot_rows(self, buf_slot: int):
+        return slice(buf_slot * self.pb, buf_slot * self.pb + self.pb)
+
+
+def compile_graph(
+    graph: Graph,
+    sched: Schedule,
+    dtype,
+    name: str = "megakernel",
+) -> CompiledMega:
+    """Lower (graph, schedule) to one pallas_call (the reference's
+    ModelBuilder.compile, model_builder.py:372-389: codegen + jit). The
+    queue array is built once; the returned `run` is pure and jittable
+    (call it inside shard_map for world>1 graphs)."""
+    B = graph.batch
+    PB = round_up(B, min_tile(dtype)[0])
+    tasks = graph.tasks
+    if sched.watermarks.shape[1] != 1:
+        raise NotImplementedError(
+            "megakernel execution currently lowers single-core queues; "
+            "multi-core schedules are planner-only (v5e/v6e have one "
+            "TensorCore per chip)"
+        )
+
+    # branch table: first-seen order over the scheduled queue
+    branch_keys: List[Any] = []
+    branch_of: Dict[Any, int] = {}
+    for t in tasks:
+        if t.branch_key not in branch_of:
+            branch_of[t.branch_key] = len(branch_keys)
+            branch_keys.append(t.branch_key)
+
+    # queue rows in schedule order, buffer args rewritten to slots
+    order = sched.order
+    queue = np.zeros((len(order), ROW), np.int32)
+    for qi, tid in enumerate(order):
+        t = tasks[tid]
+        row = [branch_of[t.branch_key]] + list(t.args)
+        row += [0] * (ROW - len(row))
+        for pos_ in t.buf_args:
+            row[1 + pos_] = int(sched.buf_slot[row[1 + pos_]])
+        queue[qi] = row[:ROW]
+
+    # static dims
+    wmax = round_up(max(b.width for b in graph.buffers), 128)
+    for k in branch_keys:
+        if k[0] == "attention":  # padded staging layout (attention branch)
+            wmax = max(wmax, round_up(k[1] * k[3], 128)
+                       + 2 * round_up(k[2] * k[3], 128))
+    mm_keys = [k for k in branch_keys if k[0] == "matmul"]
+    kmax = max((k[2] for k in mm_keys), default=128)
+    tnmax = max((_fit_tile(k[3]) for k in mm_keys), default=128)
+    at_keys = [k for k in branch_keys if k[0] == "attention"]
+    assert len({k[1:] for k in at_keys}) <= 1, (
+        "one attention geometry per megakernel graph"
+    )
+    if at_keys:
+        _, hq_l, hkv_l, D, SMAX, _, _, _, _ = at_keys[0]
+        half = D // 2
+    else:
+        hkv_l, D, SMAX, half = 1, 128, 8, 64
+    ar_keys = [k for k in branch_keys if k[0] in ("allreduce_add",
+                                                  "barrier")]
+    arw = max((k[1] for k in ar_keys if k[0] == "allreduce_add"),
+              default=128)
+    world = max((k[-1] for k in ar_keys), default=1)
+    weight_names = sorted({k[1] for k in mm_keys})
+    norm_ws = [k[1] for k in branch_keys if k[0] == "rms_norm"]
+    if any(k[6] for k in at_keys):  # use_qk_norm
+        norm_ws.append(D)
+    norm_width = round_up(max(norm_ws, default=128), 128)
+
+    n_slots = sched.n_slots
+    isz = jnp.dtype(dtype).itemsize
+    vmem = (
+        4 * PB * wmax * max(isz, 4)
+        + 2 * kmax * tnmax * isz
+        + 2 * B * SMAX * D * isz
+        + 2 * world * PB * arw * isz
+        + (4 << 20)
+    )
+
+    def kernel(q_ref, pos_ref, ws_in, *rest):
+        nw = len(weight_names)
+        w_refs = rest[:nw]
+        (norms, rope_cs, k_cache, v_cache,
+         ws_out,
+         vin, vin2, vout, vw, vkv, vrope, vnq, vnk, mailbox,
+         ld1, ld2, st, wsems, kvsem, send, recv) = rest[nw:]
+        del ws_in  # aliased: access via the output ref
+        env = _Env(
+            dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
+            ws=ws_out, weights=dict(zip(weight_names, w_refs)),
+            norms=norms, rope_cs=rope_cs, k_cache=k_cache,
+            v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
+            vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, mailbox=mailbox,
+            ld1=ld1, ld2=ld2,
+            st=st, wsems=wsems, kvsem=kvsem, send=send, recv=recv,
+        )
+        bodies = [_BRANCH_BUILDERS[k[0]](k, env) for k in branch_keys]
+        ti = pl.program_id(0)
+        a = [q_ref[ti, j] for j in range(1, ROW)]
+        jax.lax.switch(q_ref[ti, 0], [lambda f=f: f(a) for f in bodies])
+
+    def run(pos, ws, weights: Dict[str, jax.Array], norms, rope_cs,
+            k, v):
+        any_spec = pl.BlockSpec(memory_space=pl.ANY)
+        nw = len(weight_names)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(len(order),),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [any_spec] * (1 + nw + 4),
+            out_specs=any_spec,
+            scratch_shapes=[
+                pltpu.VMEM((PB, wmax), dtype),           # vin
+                pltpu.VMEM((max(PB, 2), wmax), dtype),   # vin2 (rows 0/1:
+                                                         #  norm vectors)
+                pltpu.VMEM((PB, wmax), dtype),           # vout
+                pltpu.VMEM((2, kmax, tnmax), dtype),     # vw double buffer
+                pltpu.VMEM((2, B, SMAX, D), dtype),      # vkv
+                pltpu.VMEM((B, 8, D), jnp.float32),      # vrope stripes
+                # f32 8-row stripes (see _rms_norm_branch)
+                pltpu.VMEM((8, norm_width), jnp.float32),  # vnq
+                pltpu.VMEM((8, norm_width), jnp.float32),  # vnk
+                pltpu.VMEM((2, world, PB, arw), dtype),  # AR mailbox
+                pltpu.SemaphoreType.DMA,                 # ld1
+                pltpu.SemaphoreType.DMA,                 # ld2
+                pltpu.SemaphoreType.DMA,                 # st
+                pltpu.SemaphoreType.DMA((2,)),           # wsems
+                pltpu.SemaphoreType.DMA,                 # kvsem
+                pltpu.SemaphoreType.DMA,                 # send
+                pltpu.SemaphoreType.DMA,                 # recv
+            ],
+        )
+        fn = tpu_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+            # inputs: queue(0) pos(1) ws(2) weights(3..) norms rope k v
+            input_output_aliases={2: 0},
+            compiler_params=compiler_params(
+                has_side_effects=True,
+                collective_id=next_collective_id(name) if world > 1
+                else None,
+                vmem_limit_bytes=int(vmem),
+                dimension_semantics=("arbitrary",),
+            ),
+        )
+        w_list = [weights[n] for n in weight_names]
+        return fn(jnp.asarray(queue), pos, ws, *w_list, norms, rope_cs,
+                  k, v)
+
+    return CompiledMega(
+        run=run, queue=queue, n_slots=n_slots, pb=PB, wmax=wmax,
+        norm_width=norm_width, branch_keys=branch_keys,
+        weight_names=weight_names,
+    )

@@ -1,0 +1,293 @@
+"""Qwen3 megakernel model: the whole TP decode layer stack as one task
+graph executed by a single persistent Pallas kernel per step.
+
+TPU-native re-design of the reference's Qwen3 megakernel
+(ref: python/triton_dist/mega_triton_kernel/models/qwen3.py and
+models/layers/{tp_attn,tp_mlp}.py): the per-layer make_* calls build one
+Graph; the scheduler orders it; compile_graph lowers it to one
+pallas_call. The decode step is then: embed (XLA gather) -> megakernel ->
+lm_head matmul + logits all-gather (XLA) -> KV scatter (XLA
+dynamic-update fused into the same jit) — two XLA ops around one kernel,
+the TPU shape of "one launch per decode step".
+
+Weights reuse models.dense's DenseLLMParams layout verbatim, so a
+DenseLLM/Engine checkpoint drops in (the ref megakernel also reuses the
+HF weights of its eager model, test/models/test_qwen3.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.layers import rope_table
+from triton_dist_tpu.mega.builder import ModelBuilder
+from triton_dist_tpu.mega.kernel import CompiledMega, compile_graph
+from triton_dist_tpu.mega.scheduler import schedule_graph, validate_schedule
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import (
+    DenseLLMParams,
+    init_params,
+    param_specs,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+class MegaKVCache(NamedTuple):
+    """Decode cache in megakernel layout (L, Hkv_loc, B, S_max, D): the
+    per-head read `k[layer, h]` slices only leading dims, which is the
+    Mosaic-friendly access (kernel.py module docstring)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # (B,)
+
+    @staticmethod
+    def create(cfg: ModelConfig, batch: int, s_max: int, hkv_loc: int):
+        shape = (cfg.num_layers, hkv_loc, batch, s_max, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        return MegaKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                           jnp.zeros((batch,), jnp.int32))
+
+    @staticmethod
+    def from_dense(cache, s_max: Optional[int] = None) -> "MegaKVCache":
+        """Convert a models.kv_cache.KVCache (L, B, T, Hkv, D) — e.g. the
+        output of an Engine prefill — into megakernel layout."""
+        k = jnp.moveaxis(cache.k, 3, 1)  # (L, Hkv, B, T, D)
+        v = jnp.moveaxis(cache.v, 3, 1)
+        if s_max is not None and s_max != k.shape[3]:
+            pad = s_max - k.shape[3]
+            assert pad >= 0, "prefill longer than megakernel s_max"
+            k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        return MegaKVCache(k, v, cache.length)
+
+
+def build_qwen3_graph(
+    cfg: ModelConfig, batch: int, world: int, s_max: int,
+    axis: str = TP_AXIS,
+) -> Tuple[ModelBuilder, dict]:
+    """The decode-step task graph (ref: Qwen3 model build over
+    model_builder.make_* calls, mega_triton_kernel/models/qwen3.py).
+
+    Norms-array row layout (stacked into one (4L+1, NW) input):
+      [0,L) input_ln · [L,2L) post_attn_ln · [2L] final_ln ·
+      [2L+1,3L+1) q_norm · [3L+1,4L+1) k_norm
+    """
+    n = world
+    L = cfg.num_layers
+    H = cfg.hidden_size
+    D = cfg.head_dim
+    hq_l = cfg.num_q_heads // n
+    hkv_l = cfg.num_kv_heads // n
+    i_l = cfg.intermediate_size // n
+    wqkv = (hq_l + 2 * hkv_l) * D
+
+    mb = ModelBuilder(batch, axis, world=n)
+    x = mb.buffer(H, "x", pinned=True)
+    mb.make_barrier()
+    kn_bufs, vn_bufs = [], []
+    for l in range(L):
+        h1 = mb.make_rms_norm(l, x, H, cfg.rms_eps, tag=f"ln1[{l}]")
+        qkv = mb.make_matmul("w_qkv", l, h1, H, wqkv, tag=f"qkv[{l}]")
+        attn, kn, vn = mb.make_attention(
+            l, qkv, hq_l, hkv_l, D, s_max, cfg.rms_eps, cfg.use_qk_norm,
+            q_norm_base=2 * L + 1, k_norm_base=3 * L + 1,
+        )
+        kn_bufs.append(kn)
+        vn_bufs.append(vn)
+        o = mb.make_matmul("w_o", l, attn, hq_l * D, H, tag=f"o[{l}]")
+        x = mb.make_allreduce_add(o, x, H, tag=f"ar_attn[{l}]")
+        h2 = mb.make_rms_norm(L + l, x, H, cfg.rms_eps, tag=f"ln2[{l}]")
+        gu = mb.make_matmul("w_gate_up", l, h2, H, 2 * i_l,
+                            tag=f"gate_up[{l}]")
+        act = mb.make_silu_mul(gu, i_l)
+        dn = mb.make_matmul("w_down", l, act, i_l, H, tag=f"down[{l}]")
+        x = mb.make_allreduce_add(dn, x, H, tag=f"ar_mlp[{l}]")
+    final = mb.make_rms_norm(2 * L, x, H, cfg.rms_eps, tag="final_ln")
+    mb.graph.pinned[final.id] = True
+    meta = dict(
+        input_buf=0, final=final, kn_bufs=kn_bufs, vn_bufs=vn_bufs,
+        hq_l=hq_l, hkv_l=hkv_l, i_l=i_l, wqkv=wqkv,
+    )
+    return mb, meta
+
+
+class MegaQwen3:
+    """Engine-compatible decode over the megakernel (ref: ModelBuilder
+    compile/run + model_server loop, mega_triton_kernel/test/models/).
+
+    decode_step matches models.engine.Engine.decode_step's contract:
+    tokens (B,) -> (logits (B, V) f32, cache). Prefill runs through the
+    regular Engine (the megakernel covers decode, like the reference);
+    `from_engine`/MegaKVCache.from_dense bridge the cache layouts.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        mesh,
+        batch: int,
+        axis: str = TP_AXIS,
+        s_max: Optional[int] = None,
+        params: Optional[DenseLLMParams] = None,
+        seed: int = 0,
+        fast_init: bool = False,
+        donate_cache: bool = True,
+    ):
+        assert not cfg.is_moe, "megakernel covers the dense decode graph"
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.batch = batch
+        self.s_max = s_max or cfg.max_positions
+        n = int(mesh.shape[axis])
+        self.world = n
+        self.hkv_loc = cfg.num_kv_heads // n
+        self.params = (
+            params if params is not None
+            else init_params(cfg, mesh, seed, axis, fast=fast_init)
+        )
+        dt = jnp.dtype(cfg.dtype)
+        self.dtype = dt
+
+        mb, meta = build_qwen3_graph(cfg, batch, n, self.s_max, axis)
+        self.graph = mb.graph
+        sched = schedule_graph(self.graph)
+        validate_schedule(self.graph, sched)
+        self.sched = sched
+        self.cm: CompiledMega = compile_graph(
+            self.graph, sched, dt, name=f"mega_qwen3_{axis}{n}"
+        )
+        self._meta = meta
+
+        L = cfg.num_layers
+        NW = self.cm.norm_width
+        cos, sin = rope_table(cfg.head_dim, cfg.max_positions,
+                              cfg.rope_theta)
+        rope_cs = jnp.concatenate([cos, sin], axis=-1)  # (P, D) f32
+        # 8-row stripes (see kernel.py norm/rope loads)
+        self._rope_cs = jnp.repeat(rope_cs, 8, axis=0)
+        self._norms = self._stack_norms(self.params)  # params-only: once
+
+        slot = sched.buf_slot
+        pb = self.cm.pb
+        self._x_rows = int(slot[0]) * pb  # buffer 0 is the residual input
+        self._final_rows = int(slot[meta["final"].id]) * pb
+        self._kn_rows = np.array([int(slot[b.id]) * pb
+                                  for b in meta["kn_bufs"]])
+        self._vn_rows = np.array([int(slot[b.id]) * pb
+                                  for b in meta["vn_bufs"]])
+
+        p_specs = param_specs(axis, moe=False)
+        c_specs = MegaKVCache(k=P(None, axis), v=P(None, axis),
+                              length=P())
+
+        def step(params: DenseLLMParams, tokens, cache: MegaKVCache):
+            return self._device_step(params, tokens, cache)
+
+        self._decode = jax.jit(
+            jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(p_specs, P(), c_specs),
+                out_specs=(P(), c_specs),
+                check_vma=False,
+            ),
+            donate_argnums=(2,) if donate_cache else (),
+        )
+
+    # -- per-device step (inside shard_map) ---------------------------------
+
+    def _stack_norms(self, params: DenseLLMParams):
+        """Stacked norms (4L+1, NW) in f32 8-row stripes (packed bf16
+        rows cannot be rank-reduced-sliced by a dynamic index on Mosaic;
+        see kernel.py). Depends only on params — computed once at init and
+        closed over by the jit (like the rope table)."""
+        NW = self.cm.norm_width
+        lp = params.layers
+
+        def pad_to(v, w):
+            return jnp.pad(v.astype(jnp.float32),
+                           ((0, 0), (0, w - v.shape[-1])))
+
+        norms = jnp.concatenate([
+            pad_to(lp.input_ln, NW),
+            pad_to(lp.post_attn_ln, NW),
+            pad_to(params.final_ln[None, :], NW),
+            pad_to(lp.q_norm, NW),
+            pad_to(lp.k_norm, NW),
+        ], axis=0)
+        return jnp.repeat(norms, 8, axis=0)
+
+    def _device_step(self, params: DenseLLMParams, tokens, cache):
+        cfg = self.cfg
+        L = cfg.num_layers
+        H = cfg.hidden_size
+        B = self.batch
+        pb = self.cm.pb
+        lp = params.layers
+        dt = self.dtype
+        norms = self._norms
+
+        weights = {
+            "w_qkv": lp.w_qkv[:, 0],
+            "w_o": lp.w_o[:, 0],
+            "w_gate_up": lp.w_gate_up[:, 0],
+            "w_down": lp.w_down[:, 0],
+        }
+
+        x = params.embed[tokens].astype(dt)  # (B, H)
+        ws = self.cm.workspace(dt)
+        ws = jax.lax.dynamic_update_slice(ws, x, (self._x_rows, 0))
+        pos = cache.length
+
+        ws_o = self.cm.run(pos, ws, weights, norms, self._rope_cs,
+                           cache.k, cache.v)
+
+        hidden = jax.lax.dynamic_slice(
+            ws_o, (self._final_rows, 0), (pb, self.cm.wmax)
+        )[:B, :H]
+        head = params.lm_head[0]  # (H, V_loc)
+        logits = jnp.dot(hidden, head, preferred_element_type=jnp.float32)
+        logits = jax.lax.all_gather(logits, self.axis, axis=1, tiled=True)
+
+        # KV scatter: gather the per-layer k/v rows out of the workspace
+        # and write them at each sequence's position (the ref's paged KV
+        # append, models/paged_kv_cache.py, as one fused XLA scatter).
+        kw = self.hkv_loc * cfg.head_dim
+        row_idx = (jnp.asarray(self._kn_rows)[:, None]
+                   + jnp.arange(B)[None, :])  # (L, B)
+        kn = ws_o[row_idx][..., :kw].reshape(L, B, self.hkv_loc,
+                                             cfg.head_dim)
+        row_idx_v = (jnp.asarray(self._vn_rows)[:, None]
+                     + jnp.arange(B)[None, :])
+        vn = ws_o[row_idx_v][..., :kw].reshape(L, B, self.hkv_loc,
+                                               cfg.head_dim)
+        kn = jnp.moveaxis(kn, 2, 1)  # (L, Hkv, B, D)
+        vn = jnp.moveaxis(vn, 2, 1)
+        bidx = jnp.arange(B)
+        k = cache.k.at[:, :, bidx, cache.length].set(kn.astype(dt))
+        v = cache.v.at[:, :, bidx, cache.length].set(vn.astype(dt))
+        return logits, MegaKVCache(k, v, cache.length + 1)
+
+    # -- public API ----------------------------------------------------------
+
+    def new_cache(self) -> MegaKVCache:
+        cache = MegaKVCache.create(self.cfg, self.batch, self.s_max,
+                                   self.hkv_loc * self.world)
+        specs = MegaKVCache(k=P(None, self.axis),
+                            v=P(None, self.axis), length=P())
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            cache, specs,
+        )
+
+    def decode_step(self, tokens, cache: MegaKVCache):
+        """tokens (B,) -> (logits (B, V) f32, cache)."""
+        return self._decode(
+            self.params, jnp.asarray(tokens, jnp.int32), cache
+        )

@@ -1,0 +1,246 @@
+"""Megakernel scheduler: task -> per-core work queues + scoreboard
+watermarks + workspace slot plan.
+
+TPU-native re-design of the reference's scheduler
+(ref: python/triton_dist/mega_triton_kernel/core/scheduler.py:30-95). The
+reference round-robins task tuples over NUM_SMS queues; a TPU chip has
+1-2 TensorCores, so the default is critical-path list scheduling
+(strategy "least_loaded") and the scoreboard is per-core *progress
+watermarks* rather than per-tile signals: core c broadcasts "I completed
+my k-th task"; a task waits until progress[c'] >= wm[c'] for every other
+core. Same-core order subsumes same-core deps, so at num_cores=1 (v5e,
+CPU interpret) every watermark is zero and the queue is simply a
+topological order.
+
+The heavy lifting lives in the native C++ library (csrc/scheduler.cc via
+mega/_native.py); the pure-Python mirrors below implement the identical
+algorithms and are used when the native build is unavailable
+(TDT_NO_NATIVE=1 forces them — the tests cross-check both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import heapq
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from triton_dist_tpu.mega import _native
+from triton_dist_tpu.mega.core import Graph
+
+STRATEGIES = {"round_robin": 0, "blocked": 1, "least_loaded": 2}
+
+
+@dataclasses.dataclass
+class Schedule:
+    core: np.ndarray         # (n_tasks,) core of each task
+    pos: np.ndarray          # (n_tasks,) position within its core queue
+    watermarks: np.ndarray   # (n_tasks, num_cores) scoreboard waits
+    order: List[int]         # global order (core-major: core0 queue, ...)
+    queues: List[List[int]]  # per-core task id lists
+    buf_slot: np.ndarray     # (n_bufs,) workspace slot per buffer
+    n_slots: int
+    native: bool             # True when produced by the C++ scheduler
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int32))
+
+
+# -- pure-Python mirrors of the native algorithms ----------------------------
+
+
+def _py_schedule(n, edges, cost, num_cores, strategy):
+    succ = [[] for _ in range(n)]
+    indeg = [0] * n
+    for s, d in edges:
+        succ[s].append(d)
+        indeg[d] += 1
+    # critical-path priorities over reverse topo order
+    order = []
+    stack = [t for t in range(n) if indeg[t] == 0]
+    deg = list(indeg)
+    while stack:
+        t = stack.pop()
+        order.append(t)
+        for s in succ[t]:
+            deg[s] -= 1
+            if deg[s] == 0:
+                stack.append(s)
+    if len(order) != n:
+        raise ValueError("dependency cycle in megakernel graph")
+    prio = [0.0] * n
+    for t in reversed(order):
+        c = cost[t] if cost is not None else 1.0
+        prio[t] = c + max((prio[s] for s in succ[t]), default=0.0)
+
+    ready = [(-prio[t], t) for t in range(n) if indeg[t] == 0]
+    heapq.heapify(ready)
+    deg = list(indeg)
+    core = [0] * n
+    pos = [0] * n
+    core_load = [0.0] * num_cores
+    core_len = [0] * num_cores
+    scheduled = 0
+    rr = 0
+    per = (n + num_cores - 1) // num_cores
+    while ready:
+        _, t = heapq.heappop(ready)
+        if num_cores == 1:
+            c = 0
+        elif strategy == 0:
+            c = rr % num_cores
+            rr += 1
+        elif strategy == 1:
+            c = min(scheduled // per, num_cores - 1)
+        else:
+            c = min(range(num_cores), key=lambda k: core_load[k])
+        core[t] = c
+        pos[t] = core_len[c]
+        core_len[c] += 1
+        core_load[c] += cost[t] if cost is not None else 1.0
+        scheduled += 1
+        for s in succ[t]:
+            deg[s] -= 1
+            if deg[s] == 0:
+                heapq.heappush(ready, (-prio[s], s))
+    return np.array(core, np.int32), np.array(pos, np.int32)
+
+
+def _py_watermarks(n, edges, core, pos, num_cores):
+    wm = np.zeros((n, num_cores), np.int32)
+    for s, d in edges:
+        if core[s] == core[d]:
+            if pos[s] >= pos[d]:
+                raise ValueError(f"invalid schedule: dep {s}->{d} inverted")
+            continue
+        wm[d, core[s]] = max(wm[d, core[s]], pos[s] + 1)
+    return wm
+
+
+def _py_plan_slots(ndef, last, pinned):
+    n = len(ndef)
+    free_at: List[int] = []
+    slot = [0] * n
+    for b in sorted(range(n), key=lambda b: ndef[b]):
+        chosen = -1
+        if not pinned[b]:
+            for s, fa in enumerate(free_at):
+                if fa <= ndef[b]:
+                    chosen = s
+                    break
+        if chosen < 0:
+            chosen = len(free_at)
+            free_at.append(0)
+        slot[b] = chosen
+        free_at[chosen] = (1 << 30) if pinned[b] else last[b] + 1
+    return np.array(slot, np.int32), len(free_at)
+
+
+# -- public entry -------------------------------------------------------------
+
+
+def schedule_graph(
+    graph: Graph,
+    num_cores: int = 1,
+    strategy: str = "least_loaded",
+    use_native: Optional[bool] = None,
+) -> Schedule:
+    """Schedule + plan a Graph. use_native=None auto-selects the C++ lib."""
+    n = len(graph.tasks)
+    if n == 0:
+        raise ValueError("empty megakernel graph")
+    strat = STRATEGIES[strategy]
+    edges = graph.edges
+    cost = [t.cost for t in graph.tasks]
+    lib = _native.load() if use_native in (None, True) else None
+    if use_native is True and lib is None:
+        raise RuntimeError("native scheduler requested but unavailable")
+
+    if lib is not None:
+        src = _i32([e[0] for e in edges])
+        dst = _i32([e[1] for e in edges])
+        costs = np.ascontiguousarray(np.asarray(cost, np.float64))
+        core = np.zeros(n, np.int32)
+        pos = np.zeros(n, np.int32)
+        rc = lib.tdt_schedule(
+            n, len(edges),
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            costs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            num_cores, strat,
+            core.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:
+            raise ValueError(f"native scheduler failed rc={rc} "
+                             "(dependency cycle?)")
+        wm = np.zeros((n, num_cores), np.int32)
+        rc = lib.tdt_watermarks(
+            n, len(edges),
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            core.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            num_cores,
+            wm.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:
+            raise ValueError(f"native watermarks failed rc={rc}")
+    else:
+        core, pos = _py_schedule(n, edges, cost, num_cores, strat)
+        wm = _py_watermarks(n, edges, core, pos, num_cores)
+
+    queues: List[List[int]] = [[] for _ in range(num_cores)]
+    for t in range(n):
+        queues[core[t]].append(t)
+    for q in queues:
+        q.sort(key=lambda t: pos[t])
+    order = [t for q in queues for t in q]
+
+    ndef, last = graph.liveness(order)
+    pinned = [graph.pinned.get(b.id, False) for b in graph.buffers]
+    if lib is not None:
+        nd = _i32(ndef)
+        lt = _i32(last)
+        pn = np.ascontiguousarray(np.asarray(pinned, np.uint8))
+        slot = np.zeros(len(graph.buffers), np.int32)
+        n_slots = lib.tdt_plan_slots(
+            len(graph.buffers),
+            nd.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pn.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+    else:
+        slot, n_slots = _py_plan_slots(ndef, last, pinned)
+
+    return Schedule(core=np.asarray(core), pos=np.asarray(pos),
+                    watermarks=wm, order=order, queues=queues,
+                    buf_slot=slot, n_slots=int(n_slots),
+                    native=lib is not None)
+
+
+def validate_schedule(graph: Graph, sched: Schedule) -> None:
+    """Sanity invariants (tests + compile-time assert): every dep either
+    precedes its consumer on the same core or carries a watermark; no two
+    live buffers share a slot."""
+    for s, d in graph.edges:
+        if sched.core[s] == sched.core[d]:
+            assert sched.pos[s] < sched.pos[d], (s, d)
+        else:
+            assert sched.watermarks[d, sched.core[s]] >= sched.pos[s] + 1
+    ndef, last = graph.liveness(sched.order)
+    by_slot: dict = {}
+    for b in graph.buffers:
+        by_slot.setdefault(sched.buf_slot[b.id], []).append(
+            (ndef[b.id], last[b.id], b.id))
+    for slot, spans in by_slot.items():
+        spans.sort()
+        for (d1, l1, b1), (d2, l2, b2) in zip(spans, spans[1:]):
+            assert l1 < d2, (
+                f"slot {slot}: buffers {b1} and {b2} overlap "
+                f"([{d1},{l1}] vs [{d2},{l2}])"
+            )
