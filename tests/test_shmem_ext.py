@@ -1,0 +1,206 @@
+"""Tests for the extended shmem surface: getmem, broadcast, fcollect,
+team split, and the low-latency allgather (ref tests:
+test_nvshmem_api.py per-primitive coverage, test_team_split.py,
+test_fast_allgather.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels import create_ll_ag_buffer, ll_all_gather
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import compiler_params, next_collective_id, tpu_call
+from triton_dist_tpu.runtime import make_mesh, split_mesh
+
+N = 4
+SHAPE = (8, 128)
+
+
+def _mesh(n=N, axis="tp"):
+    return make_mesh((n,), (axis,))
+
+
+def _run(kernel_body, x, mesh, axis="tp", n_sems=3, out_shape=None):
+    n = int(mesh.shape[axis])
+
+    def per_device(x):
+        return tpu_call(
+            functools.partial(kernel_body, axis, n),
+            out_shape=out_shape or jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA] * n_sems,
+            compiler_params=compiler_params(
+                has_side_effects=True,
+                collective_id=next_collective_id(
+                    f"t_{kernel_body.__name__}_{axis}"),
+            ),
+        )(x)
+
+    return jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    ))(x)
+
+
+def test_getmem_shift():
+    """get from right neighbor == ring shift left."""
+    mesh = _mesh()
+
+    def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
+        shmem.barrier_all(axis)
+        me = shmem.my_pe(axis)
+        src = jax.lax.rem(me + 1, n)
+        shmem.getmem(o_ref, x_ref, s1, s2, src, axis)
+
+    x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+    out = _run(kernel, x, mesh)
+    expect = np.roll(np.asarray(x).reshape(N, 8, 128), -1, axis=0)
+    np.testing.assert_allclose(np.asarray(out).reshape(N, 8, 128), expect)
+
+
+def test_getmem_explicit_inverse():
+    """Non-shift permutation with the reader map passed explicitly:
+    bit-reversal on 4 ranks (an involution, so reader == source map)."""
+    mesh = _mesh()
+
+    def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
+        shmem.barrier_all(axis)
+        me = shmem.my_pe(axis)
+        # 2-bit reversal 0,2,1,3 — an involution, so reader == source map
+        p = ((me & 1) << 1) | (me >> 1)
+        shmem.getmem(o_ref, x_ref, s1, s2, p, axis, reader_pe=p)
+
+    x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+    out = _run(kernel, x, mesh)
+    got = np.asarray(out).reshape(N, 8, 128)
+    xs = np.asarray(x).reshape(N, 8, 128)
+    for r, s in enumerate([0, 2, 1, 3]):
+        np.testing.assert_allclose(got[r], xs[s])
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_broadcast(root):
+    mesh = _mesh()
+
+    def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
+        shmem.barrier_all(axis)
+        shmem.broadcast(o_ref, x_ref, s1, s2, root, axis, n)
+
+    x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+    out = _run(kernel, x, mesh)
+    got = np.asarray(out).reshape(N, 8, 128)
+    xs = np.asarray(x).reshape(N, 8, 128)
+    for r in range(N):
+        np.testing.assert_allclose(got[r], xs[root], err_msg=f"rank {r}")
+
+
+def test_fcollect():
+    mesh = _mesh()
+
+    def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
+        shmem.barrier_all(axis)
+        shmem.fcollect(o_ref, x_ref, s1, s2, s3, axis, n)
+
+    x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+
+    def per_device(x):
+        return tpu_call(
+            functools.partial(kernel, "tp", N),
+            out_shape=jax.ShapeDtypeStruct((N * 8, 128), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA] * 3,
+            compiler_params=compiler_params(
+                has_side_effects=True,
+                collective_id=next_collective_id("t_fcollect"),
+            ),
+        )(x)
+
+    out = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=P("tp"), out_specs=P(None, "tp"),
+        check_vma=False,
+    ))(x)
+    # every rank holds the full gather; out is (N*8, 128*N) col-stacked
+    got = np.asarray(out)
+    xs = np.asarray(x)
+    for r in range(N):
+        np.testing.assert_allclose(got[:, r * 128:(r + 1) * 128], xs)
+
+
+def test_split_mesh_teams():
+    mesh = _mesh(4, "tp")
+    m2 = split_mesh(mesh, "tp", (2, 2), ("pp", "tp"))
+    assert m2.shape == {"pp": 2, "tp": 2}
+    # collectives address the sub-teams by name
+    x = jnp.arange(8, dtype=jnp.float32)
+
+    def f(x):
+        return jax.lax.psum(x, "tp"), jax.lax.psum(x, "pp")
+
+    a, b = jax.jit(jax.shard_map(
+        f, mesh=m2, in_specs=P(("pp", "tp")),
+        out_specs=(P(("pp", "tp")), P(("pp", "tp"))), check_vma=False,
+    ))(x)
+    # tp-psum sums within a pp row's two shards; pp-psum across rows
+    xs = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+    np.testing.assert_allclose(
+        np.asarray(a).reshape(2, 2, 2),
+        np.repeat(xs.sum(1, keepdims=True), 2, axis=1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(b).reshape(2, 2, 2),
+        np.repeat(xs.sum(0, keepdims=True), 2, axis=0),
+    )
+    with pytest.raises(ValueError, match="do not cover"):
+        split_mesh(mesh, "tp", (3, 2), ("a", "b"))
+
+
+def test_ll_all_gather_matches_xla_and_reuses_buffer():
+    mesh = _mesh()
+    x0 = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+
+    def per_device(x, buf):
+        out0, buf = ll_all_gather(x, buf, 0, "tp")
+        # second call on the same context (odd parity, no barrier)
+        out1, buf = ll_all_gather(x * 2, buf, 1, "tp")
+        # third call wraps to even parity again
+        out2, buf = ll_all_gather(x + 1, buf, 2, "tp")
+        return out0, out1, out2
+
+    buf = create_ll_ag_buffer((8, 128), jnp.float32, N)
+    o0, o1, o2 = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P("tp"), P()),
+        out_specs=P(None, None, "tp"), check_vma=False,
+    ))(x0, buf)
+    for r in range(N):
+        got0 = np.asarray(o0)[:, :, r * 128:(r + 1) * 128]
+        np.testing.assert_allclose(got0.reshape(N * 8, 128), np.asarray(x0))
+        got1 = np.asarray(o1)[:, :, r * 128:(r + 1) * 128]
+        np.testing.assert_allclose(got1.reshape(N * 8, 128),
+                                   np.asarray(x0) * 2)
+        got2 = np.asarray(o2)[:, :, r * 128:(r + 1) * 128]
+        np.testing.assert_allclose(got2.reshape(N * 8, 128),
+                                   np.asarray(x0) + 1)
+
+
+def test_ll_all_gather_world1():
+    mesh = _mesh(1)
+    x = jnp.ones((8, 128), jnp.float32)
+    buf = create_ll_ag_buffer((8, 128), jnp.float32, 1)
+
+    def per_device(x, buf):
+        out, buf = ll_all_gather(x, buf, 0, "tp")
+        return out
+
+    out = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P("tp"), P()), out_specs=P("tp"),
+        check_vma=False,
+    ))(x, buf)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x)[None])

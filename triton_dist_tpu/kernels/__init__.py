@@ -42,6 +42,10 @@ from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
     gemm_ar,
     gemm_ar_ref,
 )
+from triton_dist_tpu.kernels.low_latency_allgather import (  # noqa: F401
+    create_ll_ag_buffer,
+    ll_all_gather,
+)
 from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
     all_to_all,
     fast_all_to_all,

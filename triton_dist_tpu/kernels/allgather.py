@@ -104,32 +104,10 @@ def _ring_ag_kernel(axis: str, n: int, x_ref, o_ref, local_sem, send_sem, recv_s
 
 def _full_mesh_ag_kernel(axis: str, n: int, x_ref, o_ref, local_sem, send_sem, recv_sem):
     """Full-mesh push AG: put the local shard directly into every peer's
-    slot `me` (ref: allgather.py:81-138 cp_engine full-mesh push)."""
-    me = jax.lax.axis_index(axis)
-    m = x_ref.shape[0]
+    slot `me` (ref: allgather.py:81-138 cp_engine full-mesh push). The
+    body is the device-side `fcollect` primitive."""
     shmem.barrier_all(axis)
-
-    cp = pltpu.make_async_copy(x_ref, o_ref.at[pl.ds(me * m, m)], local_sem)
-    cp.start()
-
-    handles = []
-    for i in range(1, n):
-        peer = jnp.mod(me + i, n)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=x_ref,
-            dst_ref=o_ref.at[pl.ds(me * m, m)],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        handles.append(rdma)
-    cp.wait()
-    for h in handles:
-        # wait() covers our n-1 sends and, by symmetry, the n-1 incoming
-        # puts of identical size targeting our slots.
-        h.wait()
+    shmem.fcollect(o_ref, x_ref, local_sem, send_sem, recv_sem, axis, n)
 
 
 def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,

@@ -59,10 +59,14 @@ class AgGemmConfig:
     tile_k: int = 1024
     # VMEM ceiling for the auto fallback decision.
     vmem_budget: int = 14 << 20
+    # race provocation (ref straggler_option, allgather_gemm.py:602-603):
+    # stall this rank for straggler_ns at the producer entry
+    straggler_rank: int = -1
+    straggler_ns: int = 0
 
 
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
-                    tm: int, tn: int, tk: int, out_dtype,
+                    tm: int, tn: int, tk: int, out_dtype, straggler,
                     a_ref, b_ref, ws_ref, c_ref,
                     a_buf, acc, stage,
                     ld_sems, st_sem, cp_sem, send_sem, recv_sems):
@@ -110,6 +114,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     def _first_step():
         if n > 1:
             shmem.neighbor_barrier(axis, me, n)
+            shmem.straggler_delay(axis, *straggler)
         cp = pltpu.make_async_copy(
             a_ref, ws_ref.at[pl.ds(me * m_loc, m_loc)], cp_sem
         )
@@ -248,7 +253,8 @@ def ag_gemm(
     grid = (n, mt, nt, nk)
     ws, c = tpu_call(
         functools.partial(_ag_gemm_kernel, axis, n, mt, nt, nk,
-                          tm, tn, tk, out_dtype),
+                          tm, tn, tk, out_dtype,
+                          (cfg.straggler_rank, cfg.straggler_ns)),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),

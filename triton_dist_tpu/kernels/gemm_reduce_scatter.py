@@ -46,6 +46,9 @@ from triton_dist_tpu.runtime.init import TP_AXIS
 class GemmRsConfig:
     tile_m: int = 128
     vmem_budget: int = 14 << 20
+    # race provocation (ref straggler_option, allreduce.py:137-142)
+    straggler_rank: int = -1
+    straggler_ns: int = 0
 
 
 def _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, dst, ld_sem,
@@ -63,7 +66,7 @@ def _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, dst, ld_sem,
         ).astype(out_dtype)
 
 
-def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype,
+def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
                     a_ref, b_ref, o_ref, acc, stage, a_tile,
                     ld_sem, st_sem, send_sem, recv_sems, credit_sem):
     me = jax.lax.axis_index(axis)
@@ -80,6 +83,7 @@ def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype,
         return
 
     shmem.neighbor_barrier(axis, me, n)
+    shmem.straggler_delay(axis, *straggler)
     # Step-0 incoming targets our slot 1 (free): grant left one credit
     # (flow-control protocol of reduce_scatter._ring_rs_kernel).
     pltpu.semaphore_signal(
@@ -173,7 +177,8 @@ def gemm_rs(
         return jax.lax.psum_scatter(partial, axis, tiled=True)
 
     return tpu_call(
-        functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype),
+        functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype,
+                          (cfg.straggler_rank, cfg.straggler_ns)),
         out_shape=jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),

@@ -1,0 +1,120 @@
+"""Low-latency allgather for small messages — barrier-free steady state.
+
+TPU-native re-design of the reference's LL fast allgather
+(ref: python/triton_dist/kernels/nvidia/low_latency_allgather.py:530-607
+`_pack_ll_block`/`_recv_ll_block` — LAMPORT-style 8-byte flag-in-data
+packing so the receiver validates payload arrival without a separate
+signal round-trip; context `FastAllGatherContext` :781).
+
+On TPU the DMA delivery semaphore IS the flag: it is updated by the same
+hardware transaction that writes the payload, so flag-in-data packing is
+obviated. What the LL design still contributes — and what this kernel
+keeps — is the *barrier-free steady state* via double buffering:
+
+  - the destination is a persistent (2, n, ...) context buffer; call k
+    uses slot parity k%2;
+  - each parity has its own recv semaphore (recv_sems[parity]): a
+    semaphore increment can never be attributed to the wrong call,
+    because call k+2 (same parity) on any peer is gated behind that
+    peer's call k+1 wait, which is gated behind OUR call-k consume —
+    exactly the flag-validation ordering of the LL protocol, carried by
+    semaphore counting instead of flag words (the `call_count % 2`
+    double buffer of the reference, low_latency_all_to_all.py:36-118);
+  - only the FIRST call on a fresh context barriers the team (the
+    reference syncs at context creation).
+
+Use for latency-class payloads (flash-decode partials, splits metadata).
+Bandwidth-class payloads want the ring/2-axis kernels in allgather.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.lang.core import (
+    compiler_params,
+    interpret_no_headroom,
+    next_collective_id,
+    tpu_call,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+def create_ll_ag_buffer(x_shape, dtype, n: int) -> jax.Array:
+    """Persistent per-device context buffer (2 parities × n slots), the
+    FastAllGatherContext analog. Thread it through calls (it is donated /
+    aliased by the kernel)."""
+    return jnp.zeros((2, n) + tuple(x_shape), dtype)
+
+
+def _ll_ag_kernel(axis: str, n: int, flags_ref, x_ref, buf_in, buf_out,
+                  send_sem, recv_sems, local_sem):
+    parity = flags_ref[0]
+    first = flags_ref[1]
+    del buf_in  # aliased: access through buf_out
+
+    @pl.when(first == 1)
+    def _():
+        # fresh context: peers must be inside the kernel before the first
+        # puts land (afterwards the parity protocol orders everything)
+        shmem.barrier_all(axis)
+
+    shmem.fcollect_slots(
+        lambda pe: buf_out.at[parity, pe], x_ref,
+        local_sem, send_sem, recv_sems.at[parity], axis, n,
+    )
+
+
+def ll_all_gather(
+    x: jax.Array,
+    buf: jax.Array,
+    call_count,
+    axis: str = TP_AXIS,
+) -> Tuple[jax.Array, jax.Array]:
+    """Small-message AG: returns (gathered (n,)+x.shape, new buf).
+
+    Per-device inside shard_map. `call_count` is the 0-based call index
+    on this context buffer (python int or traced scalar); call 0 performs
+    the one-time entry barrier. The context must not be shared by two
+    in-flight collectives."""
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x[None], buf
+    if interpret_no_headroom():
+        return jax.lax.all_gather(x, axis), buf
+
+    call_count = jnp.asarray(call_count, jnp.int32)
+    flags = jnp.stack([
+        jnp.asarray(call_count % 2, jnp.int32),
+        jnp.asarray(call_count == 0, jnp.int32),
+    ])
+    kernel = functools.partial(_ll_ag_kernel, axis, n)
+    buf = tpu_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        input_output_aliases={2: 0},
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(f"ll_ag_{axis}"),
+        ),
+    )(flags, x, buf)
+    parity = call_count % 2
+    return jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False), buf

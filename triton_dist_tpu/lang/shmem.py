@@ -262,3 +262,132 @@ def sync_all(axis: AxisName) -> None:
     """Alias of barrier_all — on TPU there is no separate 'quiet' phase
     because delivery semaphores already track payload arrival."""
     barrier_all(axis)
+
+
+def straggler_delay(axis: AxisName, rank, nanos: int) -> None:
+    """Race-provocation hook: stall one team member inside the kernel
+    (ref: the `straggler_option` per-rank torch.cuda._sleep injection,
+    allgather_gemm.py:602-603 / allreduce.py:137-142, and the
+    `for_correctness` random producer sleeps, allgather.py:74-78). A
+    protocol kernel that is only correct when ranks happen to run in
+    lockstep will corrupt data or hang under this delay — which is the
+    point. rank < 0 or nanos == 0 is a no-op."""
+    if nanos <= 0:
+        return
+    me = my_pe(axis)
+
+    @pl.when(me == rank)
+    def _():
+        pl.delay(nanos)
+
+
+def getmem_nbi(
+    dst_ref,
+    src_ref,
+    send_sem,
+    recv_sem,
+    from_pe,
+    axis: AxisName,
+    reader_pe=None,
+) -> PutHandle:
+    """Pull `from_pe`'s src_ref into local dst_ref
+    (ref: nvshmem_getmem_nbi_block, libshmem_device.py:181-210).
+
+    ICI RDMA is push-only, so a get is its matched push in the SPMD
+    program: every rank pushes its src to the rank that reads it. The
+    read pattern must be a team permutation me -> from_pe(me);
+    `reader_pe` is its inverse (the rank whose from_pe is me). For shift
+    patterns from_pe = me+d it defaults to me-d; pass it explicitly for
+    other permutations. The handle's wait_recv() is this rank's get
+    completion."""
+    me = my_pe(axis)
+    n = n_pes(axis)
+    if reader_pe is None:
+        # infer the matched shift: from_pe = me + d  =>  reader = me - d
+        d = jax.lax.rem(from_pe - me + n, n)
+        reader_pe = jax.lax.rem(me - d + n, n)
+    return putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, reader_pe,
+                      axis)
+
+
+def getmem(dst_ref, src_ref, send_sem, recv_sem, from_pe,
+           axis: AxisName, reader_pe=None) -> None:
+    """Blocking get: returns when the pulled payload is in dst_ref."""
+    getmem_nbi(dst_ref, src_ref, send_sem, recv_sem, from_pe, axis,
+               reader_pe).wait()
+
+
+def broadcast(dst_ref, src_ref, send_sem, recv_sem, root, axis: str,
+              n: int) -> None:
+    """Team broadcast: root's src_ref lands in every rank's dst_ref
+    (ref: nvshmem_broadcast_block wrapper, nvshmem_wrapper.cu:28-80).
+
+    Root pushes to all peers; non-roots wait one delivery. `n` must be
+    the static team size (the send fan-out is unrolled). Caller must
+    barrier the team before the FIRST collective of a kernel (same
+    precondition as fcollect): a put must never land in a peer that has
+    not yet entered the kernel."""
+    me = my_pe(axis)
+
+    @pl.when(me == root)
+    def _send():
+        cp = pltpu.make_async_copy(src_ref, dst_ref, send_sem)
+        cp.start()
+        handles = []
+        for i in range(1, n):
+            peer = jax.lax.rem(root + i, n)
+            handles.append(
+                putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, peer,
+                           axis)
+            )
+        cp.wait()
+        for h in handles:
+            h.wait_send()
+
+    @pl.when(me != root)
+    def _recv():
+        # wait descriptor: same shape/sems as the incoming put
+        pltpu.make_async_remote_copy(
+            src_ref=src_ref, dst_ref=dst_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=team_device_id(axis, me),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        ).wait_recv()
+
+
+def fcollect_slots(slot_ref_of, src_ref, local_sem, send_sem, recv_sem,
+                   axis: str, n: int) -> None:
+    """Core of fcollect with a caller-shaped destination: slot_ref_of(me)
+    must return the rank-`me` slot ref of the (symmetric) destination.
+    Used directly by kernels whose gather target is not row-flat (e.g.
+    the parity-buffered low-latency allgather)."""
+    me = my_pe(axis)
+
+    cp = pltpu.make_async_copy(src_ref, slot_ref_of(me), local_sem)
+    cp.start()
+    handles = []
+    for i in range(1, n):
+        peer = jax.lax.rem(me + i, n)
+        handles.append(
+            putmem_nbi(slot_ref_of(me), src_ref, send_sem, recv_sem,
+                       peer, axis)
+        )
+    cp.wait()
+    for h in handles:
+        # wait() covers our n-1 sends and, by symmetry, the n-1 incoming
+        # puts of identical size targeting our slots.
+        h.wait()
+
+
+def fcollect(dst_ref, src_ref, local_sem, send_sem, recv_sem,
+             axis: str, n: int) -> None:
+    """Flat collect: every rank's src_ref (m rows) gathered into every
+    rank's dst_ref (n*m rows), rank-major (ref: nvshmem_fcollect —
+    the device-side allgather primitive). Full-mesh push: each rank puts
+    its shard into slot `me` of all peers. Caller must barrier the team
+    before first use (see kernels/allgather.py full-mesh kernel)."""
+    m = src_ref.shape[0]
+    fcollect_slots(
+        lambda me: dst_ref.at[pl.ds(me * m, m)],
+        src_ref, local_sem, send_sem, recv_sem, axis, n,
+    )

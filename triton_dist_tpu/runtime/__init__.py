@@ -11,6 +11,7 @@ from triton_dist_tpu.runtime.init import (  # noqa: F401
     get_default_mesh,
     set_default_mesh,
     make_mesh,
+    split_mesh,
     rank,
     num_ranks,
     init_seed,

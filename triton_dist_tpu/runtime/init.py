@@ -77,6 +77,33 @@ def make_mesh(
     return Mesh(devices[:n].reshape(mesh_shape), tuple(axis_names))
 
 
+def split_mesh(mesh: Mesh, axis: str, sizes: Sequence[int],
+               names: Sequence[str]) -> Mesh:
+    """Split one mesh axis into sub-axes (teams): the TPU analog of
+    NVSHMEM team_split_strided (ref: shmem teams, libshmem_device.py:
+    326-340; test/nvidia/test_team_split.py). A (8,)-"tp" mesh split by
+    (2, 4) into ("pp", "tp") yields 2 pipeline groups of 4-way TP; kernels
+    address either team by its axis name."""
+    import math
+
+    if math.prod(sizes) != mesh.shape[axis]:
+        raise ValueError(
+            f"split sizes {tuple(sizes)} do not cover axis {axis} "
+            f"(size {mesh.shape[axis]})"
+        )
+    idx = mesh.axis_names.index(axis)
+    new_shape = []
+    new_names = []
+    for i, name in enumerate(mesh.axis_names):
+        if i == idx:
+            new_shape.extend(sizes)
+            new_names.extend(names)
+        else:
+            new_shape.append(mesh.devices.shape[i])
+            new_names.append(name)
+    return Mesh(mesh.devices.reshape(new_shape), tuple(new_names))
+
+
 def initialize_distributed(
     mesh_shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = (TP_AXIS,),
