@@ -33,7 +33,6 @@ report a perfect 0.0).
 """
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +44,7 @@ from triton_dist_tpu.layers import TPMLPParams, tp_mlp_dist_fwd
 from triton_dist_tpu.models import Engine, ModelConfig
 from triton_dist_tpu.models.dense import cache_specs, forward, param_specs
 from triton_dist_tpu.runtime import make_mesh
+from triton_dist_tpu.runtime.utils import chain_timer as _chain_timer
 
 # ref megakernel.md:33 — Qwen3-8B decode bs=1 seq=1 ctx=512, 8x H800 TP=8
 _BASELINE_DECODE_MS = 3.33
@@ -58,37 +58,6 @@ HIDDEN = 5120
 INTER = 25600
 N_GATE_UP = 2 * INTER // TP  # fused gate+up projection, per rank
 K_DOWN = INTER // TP
-
-
-def _chain_timer(build_fn, args, k_lo=1, k_hi=101, pairs=9, warmup=2):
-    """Interleaved paired diffs of two chain lengths inside one jit.
-
-    With a ~90 ms tunnel RTT the chain must be long enough that the signal
-    (k_hi - k_lo iterations of device time) dwarfs RTT jitter; pairing
-    lo/hi measurements back-to-back cancels slow drift. The median of the
-    per-pair diffs is the estimate; all diffs are reported raw. A
-    non-positive median is a measurement failure (never clamped)."""
-    f_lo, f_hi = build_fn(k_lo), build_fn(k_hi)
-    np.asarray(f_lo(*args))  # compile
-    np.asarray(f_hi(*args))
-
-    def once(f):
-        t0 = time.perf_counter()
-        np.asarray(f(*args))  # host fetch forces completion
-        return (time.perf_counter() - t0) * 1e3
-
-    for _ in range(warmup):
-        once(f_lo), once(f_hi)
-    diffs = [
-        (once(f_hi) - once(f_lo)) / (k_hi - k_lo) for _ in range(pairs)
-    ]
-    ms = float(np.median(diffs))
-    if ms <= 0:
-        raise RuntimeError(f"measurement failed: median diff {ms} <= 0")
-    return ms, {
-        "diffs_ms": [round(d, 4) for d in diffs],
-        "k": (k_lo, k_hi),
-    }
 
 
 def _shard_cfg():

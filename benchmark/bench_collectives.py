@@ -1,0 +1,114 @@
+"""Collective method sweep: AG / RS / AR methods across message sizes.
+
+Ref model: the per-kernel perf paths in the reference's tests
+(test_all_gather.py / test_reduce_scatter.py / test_allreduce.py report
+perf per method and size). One JSON line per (collective, method, size).
+
+Run:  python benchmark/bench_collectives.py [--tpu] [--world N]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples"))
+from common import bootstrap  # noqa: E402
+
+jax, mesh = bootstrap(
+    world=int(sys.argv[sys.argv.index("--world") + 1])
+    if "--world" in sys.argv else 4
+)
+
+import jax.numpy as jnp                                        # noqa: E402
+import numpy as np                                             # noqa: E402
+from jax.sharding import PartitionSpec as P                    # noqa: E402
+
+from triton_dist_tpu.kernels import (                          # noqa: E402
+    AllReduceMethod,
+    all_reduce,
+    full_mesh_all_gather,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
+from triton_dist_tpu.perf_model import (                       # noqa: E402
+    estimate_ag_ms,
+    estimate_ar_ms,
+    estimate_rs_ms,
+)
+from triton_dist_tpu.runtime.utils import chain_timer          # noqa: E402
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+ROWS = [256, 2048, 16384] if ON_TPU else [32]
+K_HI = 101 if ON_TPU else 3
+
+
+def _time(fn, x, out_specs):
+    """Chain-timed: k data-dependent collective calls inside one jit."""
+    del out_specs  # the chain carries the input shape
+
+    def build(k):
+        def per_rank(x):
+            def body(_, x):
+                c = fn(x)
+                return (x * (1.0 + 0.0 * jnp.sum(c.astype(jnp.float32)))
+                        ).astype(x.dtype)
+
+            out = jax.lax.fori_loop(0, k, body, x)
+            return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+        return jax.jit(jax.shard_map(
+            per_rank, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+            check_vma=False,
+        ))
+
+    ms, _ = chain_timer(build, (x,), k_hi=K_HI,
+                        pairs=7 if ON_TPU else 2, warmup=2)
+    return ms
+
+
+def main():
+    n = int(mesh.shape["tp"])
+    rng = np.random.default_rng(0)
+    for rows in ROWS:
+        x = jnp.asarray(rng.standard_normal((n * rows, 128)), jnp.float32)
+        nbytes = rows * 128 * 4
+        cases = [
+            ("allgather", "ring",
+             lambda s: ring_all_gather(s, "tp"), P(None, "tp"),
+             estimate_ag_ms(nbytes, n)),
+            ("allgather", "full_mesh",
+             lambda s: full_mesh_all_gather(s, "tp"), P(None, "tp"),
+             estimate_ag_ms(nbytes, n)),
+            ("reduce_scatter", "ring",
+             lambda s: ring_reduce_scatter(
+                 jnp.tile(s, (1, 1)), "tp"), P("tp"),
+             estimate_rs_ms(nbytes * n, n)),
+            ("allreduce", "one_shot",
+             lambda s: all_reduce(s, "tp",
+                                  method=AllReduceMethod.OneShot),
+             P("tp"), estimate_ar_ms(nbytes * n, n, method="one_shot")),
+            ("allreduce", "two_shot",
+             lambda s: all_reduce(s, "tp",
+                                  method=AllReduceMethod.TwoShot),
+             P("tp"), estimate_ar_ms(nbytes * n, n)),
+            ("allreduce", "xla",
+             lambda s: all_reduce(s, "tp", method=AllReduceMethod.XLA),
+             P("tp"), estimate_ar_ms(nbytes * n, n)),
+        ]
+        for coll, method, fn, ospec, model_ms in cases:
+            try:
+                ms = _time(fn, x, ospec)
+            except Exception as e:  # report, keep sweeping
+                print(json.dumps({"bench": coll, "method": method,
+                                  "rows": rows, "error": str(e)[:120]}))
+                continue
+            print(json.dumps({
+                "bench": coll, "method": method, "world": n,
+                "shard_rows": rows, "bytes": nbytes,
+                "ms": round(ms, 4), "model_ms": round(model_ms, 4),
+            }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Tutorial 06 — AllReduce method zoo.
+
+The reference ships 7 AR methods selected by size/topology
+(ref: kernels/nvidia/allreduce.py:28-60, :1101-1126). The TPU set:
+one-shot (latency), two-shot = RS+AG (bandwidth), XLA psum (compiler-
+scheduled), with the same auto-selection idea.
+
+Run:  python examples/06_allreduce.py [--tpu]
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from common import bootstrap
+
+jax, mesh = bootstrap(world=4)
+
+from jax.sharding import PartitionSpec as P                   # noqa: E402
+
+from triton_dist_tpu.kernels import (                         # noqa: E402
+    AllReduceMethod,
+    all_reduce,
+)
+from triton_dist_tpu.kernels.allreduce import (               # noqa: E402
+    choose_allreduce_method,
+)
+
+
+def main():
+    n = int(mesh.shape["tp"])
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.standard_normal((n, 16, 128)), jnp.float32)
+    want = np.asarray(xs).sum(0)
+
+    for method in (AllReduceMethod.OneShot, AllReduceMethod.TwoShot,
+                   AllReduceMethod.XLA):
+        out = jax.jit(jax.shard_map(
+            lambda x, m=method: all_reduce(x[0], "tp", method=m),
+            mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+            check_vma=False,
+        ))(xs)
+        np.testing.assert_allclose(
+            np.asarray(out)[:16], want, rtol=1e-5, atol=1e-5)
+        print(f"06 allreduce [{method.name}]: OK")
+    print("   auto-select for 16KiB:",
+          choose_allreduce_method(16 << 10, n).name)
+
+
+if __name__ == "__main__":
+    main()

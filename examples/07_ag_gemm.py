@@ -1,0 +1,53 @@
+"""Tutorial 07 — fused AllGather + GEMM (the flagship overlap).
+
+Port of the reference's AG+GEMM tutorial (ref: tutorials/07-overlapped-
+allgather-gemm.py; kernel allgather_gemm.py:158-575): the ring forward of
+the NEXT activation chunk rides the ICI while the MXU multiplies the
+CURRENT one; per-step delivery semaphores replace the dl.wait barrier
+words.
+
+Run:  python examples/07_ag_gemm.py [--tpu]
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from common import bootstrap
+
+jax, mesh = bootstrap(world=4)
+
+from jax.sharding import PartitionSpec as P                   # noqa: E402
+
+from triton_dist_tpu.kernels import (                         # noqa: E402
+    AgGemmConfig,
+    ag_gemm,
+    ag_gemm_ref,
+)
+
+M, K, N = 64, 128, 128
+
+
+def main():
+    n = int(mesh.shape["tp"])
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((K, N)) * 0.1, jnp.float32)
+    cfg = AgGemmConfig(tile_m=M // n, tile_n=N // n, tile_k=K)
+
+    out = jax.jit(jax.shard_map(
+        lambda a, b: ag_gemm(a, b, "tp", config=cfg, force_kernel=True),
+        mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+        out_specs=P(None, "tp"), check_vma=False,
+    ))(a, b)
+    ref = jax.jit(jax.shard_map(
+        lambda a, b: ag_gemm_ref(a, b, "tp"),
+        mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+        out_specs=P(None, "tp"), check_vma=False,
+    ))(a, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    print(f"07 AG+GEMM: fused ring/MXU pipeline == unfused reference "
+          f"(n={n})")
+
+
+if __name__ == "__main__":
+    main()

@@ -271,14 +271,33 @@ def straggler_delay(axis: AxisName, rank, nanos: int) -> None:
     `for_correctness` random producer sleeps, allgather.py:74-78). A
     protocol kernel that is only correct when ranks happen to run in
     lockstep will corrupt data or hang under this delay — which is the
-    point. rank < 0 or nanos == 0 is a no-op."""
+    point. rank < 0 or nanos == 0 is a no-op.
+
+    Native TPU uses pl.delay (cycle-accurate). pl.delay is a NO-OP in
+    interpret mode, so on the CPU mesh the stall is a loop of effectful
+    self-signal/wait pairs on the barrier semaphore — each iteration is
+    real interpreter wall time on the delayed rank's executor thread,
+    which is what actually skews rank progress there (nanos maps to
+    iterations loosely; provocation needs skew, not precision)."""
     if nanos <= 0:
         return
+    from triton_dist_tpu.lang.core import use_interpret
+
     me = my_pe(axis)
 
     @pl.when(me == rank)
     def _():
-        pl.delay(nanos)
+        if use_interpret():
+            bsem = pltpu.get_barrier_semaphore()
+
+            def churn(_, carry):
+                pltpu.semaphore_signal(bsem, inc=1)
+                pltpu.semaphore_wait(bsem, 1)
+                return carry
+
+            jax.lax.fori_loop(0, max(1, nanos // 5000), churn, 0)
+        else:
+            pl.delay(nanos)
 
 
 def getmem_nbi(

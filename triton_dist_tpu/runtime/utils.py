@@ -58,6 +58,38 @@ def perf_func(
     return out, (t1 - t0) * 1e3 / iters
 
 
+def chain_timer(build_fn, args, k_lo=1, k_hi=101, pairs=9, warmup=2):
+    """Interleaved paired diffs of two chain lengths inside one jit.
+
+    The reliable timing method behind bench.py on a high-RTT link (the
+    TPU may sit behind a ~90 ms tunnel): build_fn(k) must return a jitted
+    callable whose device time scales linearly in k via a data-dependent
+    chain; the per-iteration estimate is the median of paired
+    (k_hi - k_lo)-normalized differences, so RTT and drift cancel. A
+    non-positive median raises (never clamped — round-2 ADVICE)."""
+    f_lo, f_hi = build_fn(k_lo), build_fn(k_hi)
+    np.asarray(f_lo(*args))  # compile
+    np.asarray(f_hi(*args))
+
+    def once(f):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))  # host fetch forces completion
+        return (time.perf_counter() - t0) * 1e3
+
+    for _ in range(warmup):
+        once(f_lo), once(f_hi)
+    diffs = [
+        (once(f_hi) - once(f_lo)) / (k_hi - k_lo) for _ in range(pairs)
+    ]
+    ms = float(np.median(diffs))
+    if ms <= 0:
+        raise RuntimeError(f"measurement failed: median diff {ms} <= 0")
+    return ms, {
+        "diffs_ms": [round(d, 4) for d in diffs],
+        "k": (k_lo, k_hi),
+    }
+
+
 def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose=True):
     """allclose with mismatch dump (ref: utils.py:870-899)."""
     x = np.asarray(x)
