@@ -15,10 +15,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "examples"))
 from common import bootstrap  # noqa: E402
 
-jax, mesh = bootstrap(
-    world=int(sys.argv[sys.argv.index("--world") + 1])
-    if "--world" in sys.argv else 4
-)
+jax, mesh = bootstrap(world=4)  # --world/--tpu parsed by bootstrap
 
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
@@ -43,9 +40,8 @@ ROWS = [256, 2048, 16384] if ON_TPU else [32]
 K_HI = 101 if ON_TPU else 3
 
 
-def _time(fn, x, out_specs):
+def _time(fn, x):
     """Chain-timed: k data-dependent collective calls inside one jit."""
-    del out_specs  # the chain carries the input shape
 
     def build(k):
         def per_rank(x):
@@ -73,32 +69,32 @@ def main():
     for rows in ROWS:
         x = jnp.asarray(rng.standard_normal((n * rows, 128)), jnp.float32)
         nbytes = rows * 128 * 4
+        # model args use the collective's actual per-rank input size
         cases = [
             ("allgather", "ring",
-             lambda s: ring_all_gather(s, "tp"), P(None, "tp"),
+             lambda s: ring_all_gather(s, "tp"),
              estimate_ag_ms(nbytes, n)),
             ("allgather", "full_mesh",
-             lambda s: full_mesh_all_gather(s, "tp"), P(None, "tp"),
+             lambda s: full_mesh_all_gather(s, "tp"),
              estimate_ag_ms(nbytes, n)),
             ("reduce_scatter", "ring",
-             lambda s: ring_reduce_scatter(
-                 jnp.tile(s, (1, 1)), "tp"), P("tp"),
-             estimate_rs_ms(nbytes * n, n)),
+             lambda s: ring_reduce_scatter(s, "tp"),
+             estimate_rs_ms(nbytes, n)),
             ("allreduce", "one_shot",
              lambda s: all_reduce(s, "tp",
                                   method=AllReduceMethod.OneShot),
-             P("tp"), estimate_ar_ms(nbytes * n, n, method="one_shot")),
+             estimate_ar_ms(nbytes, n, method="one_shot")),
             ("allreduce", "two_shot",
              lambda s: all_reduce(s, "tp",
                                   method=AllReduceMethod.TwoShot),
-             P("tp"), estimate_ar_ms(nbytes * n, n)),
+             estimate_ar_ms(nbytes, n)),
             ("allreduce", "xla",
              lambda s: all_reduce(s, "tp", method=AllReduceMethod.XLA),
-             P("tp"), estimate_ar_ms(nbytes * n, n)),
+             estimate_ar_ms(nbytes, n)),
         ]
-        for coll, method, fn, ospec, model_ms in cases:
+        for coll, method, fn, model_ms in cases:
             try:
-                ms = _time(fn, x, ospec)
+                ms = _time(fn, x)
             except Exception as e:  # report, keep sweeping
                 print(json.dumps({"bench": coll, "method": method,
                                   "rows": rows, "error": str(e)[:120]}))

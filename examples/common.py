@@ -18,8 +18,15 @@ def bootstrap(world: int = 4):
 
     Default: a virtual CPU mesh with spare devices (interpret-mode Pallas
     simulates the inter-chip DMA; see tests/conftest.py for why spares
-    matter). `--tpu` uses whatever real TPU devices exist (world clamps).
+    matter). `--tpu` uses whatever real TPU devices exist (world clamps);
+    `--world N` overrides the mesh size.
     """
+    if "--world" in sys.argv:
+        i = sys.argv.index("--world")
+        try:
+            world = int(sys.argv[i + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("--world requires an integer value")
     use_tpu = "--tpu" in sys.argv
     if not use_tpu:
         os.environ["XLA_FLAGS"] = (

@@ -63,3 +63,26 @@ def test_assert_allclose_reports_mismatch():
     with pytest.raises(AssertionError, match="mismatched"):
         assert_allclose(np.zeros(4), np.ones(4))
     assert_allclose(np.ones(4), np.ones(4))
+
+
+def test_merge_traces(tmp_path):
+    """Trace-merge tooling (ref utils.py:370-502 multi-rank merge)."""
+    import os
+
+    from triton_dist_tpu.runtime.utils import merge_traces
+
+    dirs = []
+    for pid in range(2):
+        d = tmp_path / f"host{pid}"
+        run = d / "plugins" / "profile" / "2026_01_01_00_00_00"
+        os.makedirs(run)
+        (run / f"host{pid}.xplane.pb").write_bytes(b"x" * 8)
+        dirs.append(str(d))
+    out = merge_traces(dirs, str(tmp_path / "merged"))
+    runs = sorted(os.listdir(os.path.join(out, "plugins", "profile")))
+    assert runs == ["2026_01_01_00_00_00_p0", "2026_01_01_00_00_00_p1"]
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        merge_traces([str(tmp_path / "empty")], str(tmp_path / "m2"))

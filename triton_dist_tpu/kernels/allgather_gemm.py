@@ -35,6 +35,7 @@ from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
     compiler_params,
+    cost_estimate,
     next_collective_id,
     cdiv,
     interpret_no_headroom,
@@ -290,6 +291,13 @@ def ag_gemm(
                 next_collective_id(f"ag_gemm_{axis}") if n > 1 else None
             ),
             vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
+        ),
+        # launch_metadata analog (ref allgather_gemm.py:145-155)
+        cost_estimate=cost_estimate(
+            flops=2 * n * m_loc * k * n_loc,
+            bytes_accessed=(n * m_loc * k + k * n_loc) * itemsize
+            + n * m_loc * n_loc * out_itemsize,
+            remote_bytes=(n - 1) * m_loc * k * itemsize,
         ),
     )(a_shard, b)
     return (c, ws) if return_gathered else c

@@ -47,7 +47,21 @@ class EPDispatch(NamedTuple):
     send_counts: jax.Array  # (n,) slots we sent per destination
 
 
-def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
+_FP8_MAX = 448.0  # e4m3 finite max
+
+
+def _quantize_fp8(x):
+    """Per-token e4m3 quantization -> (q (M, H) fp8, scale (M,) f32)
+    (ref: the fp8 payload + scale plane of the LL dispatch,
+    low_latency_all_to_all.py:36-118)."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / _FP8_MAX
+    s = jnp.maximum(s, 1e-12)
+    q = (x.astype(jnp.float32) / s[:, None]).astype(jnp.float8_e4m3fn)
+    return q, s
+
+
+def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
+                  payload_dtype=None):
     """Build fixed-capacity per-destination send buffers.
 
     x: (M, H); ids/weights: (M, k). Returns (send_x (n, C, H_pad) with the
@@ -56,6 +70,11 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
     combine metadata; counts (n,)). Slot allocation is a stable sort by
     destination rank — the static analog of the reference's atomic slot
     counter (ep_a2a.py:133-150).
+
+    payload_dtype=float8_e4m3fn selects the fp8 wire format (half the ICI
+    bytes of bf16 — the reference's 137 us dispatch class): tokens are
+    per-token-scale quantized and the f32 scale + int32 expert id are
+    bitcast into 8 lane-padding byte columns.
     """
     m, k = ids.shape
     flat_ids = ids.reshape(-1)
@@ -78,19 +97,33 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity):
     w_flat = weights.reshape(-1)[order].astype(jnp.float32)
 
     h = x.shape[1]
-    # Fold the travelling metadata (local expert id, the only field the
-    # recv side needs) into lane-padding columns of the token payload so a
-    # SINGLE a2a moves tokens + routing. Expert ids are small integers and
-    # exact in bf16 (<= 256).
-    assert experts_per_rank <= 256 or jnp.dtype(x.dtype).itemsize >= 4, (
-        "expert id not exactly representable in bf16 lane padding"
-    )
-    h_pad = -(-(h + 1) // 128) * 128  # round_up(H+1, 128): aligned lanes
-    send_x = jnp.zeros((n_ranks * capacity, h_pad), x.dtype)
-    send_x = send_x.at[slot, :h].set(x[src_rows], mode="drop")
-    send_x = send_x.at[slot, h].set(
-        local_exp.astype(x.dtype), mode="drop"
-    )
+    if payload_dtype is not None and jnp.dtype(payload_dtype).itemsize == 1:
+        # fp8 wire format: quantized tokens + bitcast (scale, expert id)
+        q, scale = _quantize_fp8(x)
+        h_pad = -(-(h + 8) // 128) * 128  # +8 byte columns of metadata
+        send_x = jnp.zeros((n_ranks * capacity, h_pad), payload_dtype)
+        send_x = send_x.at[slot, :h].set(q[src_rows], mode="drop")
+        meta = jnp.concatenate([
+            jax.lax.bitcast_convert_type(scale[src_rows], jnp.uint8),
+            jax.lax.bitcast_convert_type(local_exp, jnp.uint8),
+        ], axis=-1)  # (M*k, 8)
+        send_x = send_x.at[slot, h:h + 8].set(
+            jax.lax.bitcast_convert_type(meta, payload_dtype), mode="drop"
+        )
+    else:
+        # Fold the travelling metadata (local expert id, the only field
+        # the recv side needs) into lane-padding columns of the token
+        # payload so a SINGLE a2a moves tokens + routing. Expert ids are
+        # small integers and exact in bf16 (<= 256).
+        assert experts_per_rank <= 256 or jnp.dtype(x.dtype).itemsize >= 4, (
+            "expert id not exactly representable in bf16 lane padding"
+        )
+        h_pad = -(-(h + 1) // 128) * 128  # round_up(H+1, 128)
+        send_x = jnp.zeros((n_ranks * capacity, h_pad), x.dtype)
+        send_x = send_x.at[slot, :h].set(x[src_rows], mode="drop")
+        send_x = send_x.at[slot, h].set(
+            local_exp.astype(x.dtype), mode="drop"
+        )
     send_row = jnp.zeros((n_ranks * capacity,), jnp.int32)
     send_row = send_row.at[slot].set(src_rows, mode="drop")
     send_w = jnp.zeros((n_ranks * capacity,), jnp.float32)
@@ -115,22 +148,44 @@ def ep_dispatch(
     n_experts: int,
     capacity: int,
     axis: str = EP_AXIS,
+    payload_dtype=None,
 ) -> EPDispatch:
     """Route tokens to their expert-owner ranks (ref dispatch path,
-    ep_a2a.py:37-150 + layers/nvidia/ep_a2a_layer.py:195)."""
+    ep_a2a.py:37-150 + layers/nvidia/ep_a2a_layer.py:195).
+
+    payload_dtype=jnp.float8_e4m3fn dispatches on the fp8 wire format
+    (the reference's latency-class configuration, README.md:93: 128
+    tok/rank topk=8 hidden=7168 fp8 at 137 us); tokens are dequantized
+    to x.dtype on arrival."""
     n = jax.lax.axis_size(axis)
     h = x.shape[1]
     experts_per_rank = n_experts // n
     send_x, send_row, send_w, send_valid, counts = _pack_by_dest(
-        x, topk_ids, topk_weights, n, experts_per_rank, capacity
+        x, topk_ids, topk_weights, n, experts_per_rank, capacity,
+        payload_dtype,
     )
     a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
     recv, recv_counts = a2a(send_x, counts, axis)
     slot_idx = jnp.arange(capacity)[None, :]
     recv_valid = slot_idx < recv_counts[:, None]
+    if payload_dtype is not None and jnp.dtype(payload_dtype).itemsize == 1:
+        meta = jax.lax.bitcast_convert_type(
+            recv[..., h:h + 8], jnp.uint8
+        ).reshape(n, capacity, 8)
+        scale = jax.lax.bitcast_convert_type(
+            meta[..., :4], jnp.float32
+        ).reshape(n, capacity)
+        local_expert = jax.lax.bitcast_convert_type(
+            meta[..., 4:], jnp.int32
+        ).reshape(n, capacity)
+        tokens = (recv[..., :h].astype(jnp.float32)
+                  * scale[..., None]).astype(x.dtype)
+    else:
+        tokens = recv[..., :h]
+        local_expert = recv[..., h].astype(jnp.int32)
     return EPDispatch(
-        x=recv[..., :h],
-        local_expert=recv[..., h].astype(jnp.int32),
+        x=tokens,
+        local_expert=local_expert,
         valid=recv_valid,
         counts=recv_counts,
         send_src_row=send_row,

@@ -33,6 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
+    cost_estimate,
     tpu_call,
     compiler_params,
     next_collective_id,
@@ -203,6 +204,13 @@ def gemm_rs(
                 next_collective_id(f"gemm_rs_{axis}") if n > 1 else None
             ),
             vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
+        ),
+        # launch_metadata analog (ref allgather_gemm.py:145-155)
+        cost_estimate=cost_estimate(
+            flops=2 * m * k_loc * n_full,
+            bytes_accessed=(m * k_loc + k_loc * n_full) * in_itemsize
+            + m_loc * n_full * out_itemsize,
+            remote_bytes=(n - 1) * m_loc * n_full * out_itemsize,
         ),
     )(a, b)
 

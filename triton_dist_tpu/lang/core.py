@@ -141,6 +141,17 @@ def next_collective_id(name: str) -> int:
     return _COLLECTIVE_IDS[name]
 
 
+def cost_estimate(flops: int = 0, bytes_accessed: int = 0,
+                  remote_bytes: int = 0) -> "pl.CostEstimate":
+    """Kernel cost metadata — the reference's `launch_metadata` flops/
+    bytes reporting (ref: allgather_gemm.py:145-155) — consumed by the
+    XLA scheduler and surfaced in profiles."""
+    return pl.CostEstimate(
+        flops=int(flops), bytes_accessed=int(bytes_accessed),
+        transcendentals=0, remote_bytes_transferred=int(remote_bytes),
+    )
+
+
 def compiler_params(
     has_side_effects: bool = False,
     collective_id: Optional[int] = None,

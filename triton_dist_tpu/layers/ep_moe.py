@@ -40,6 +40,7 @@ def ep_moe_fwd(
     top_k: int,
     capacity: Optional[int] = None,
     axis: str = EP_AXIS,
+    payload_dtype=None,
 ):
     """EP MoE forward: route -> dispatch -> local grouped FFN -> combine.
     Returns (M, H) (ref: ep_a2a_layer.py dispatch/combine +
@@ -54,7 +55,8 @@ def ep_moe_fwd(
         x.astype(jnp.float32), params.w_router.astype(jnp.float32)
     )
     weights, ids = topk_routing(logits, top_k)
-    disp = ep_dispatch(x, ids, weights, n_experts, capacity, axis)
+    disp = ep_dispatch(x, ids, weights, n_experts, capacity, axis,
+                       payload_dtype=payload_dtype)
     y = ep_expert_ffn(disp, params.w_gate_up, params.w_down)
     return ep_combine(y, disp, m, x.dtype, axis)
 

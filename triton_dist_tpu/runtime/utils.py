@@ -133,3 +133,31 @@ def group_profile(name: str = "profile", do_prof: bool = True, out_dir: str = No
     finally:
         jax.profiler.stop_trace()
         dist_print(f"profile written to {path}")
+
+
+def merge_traces(per_process_dirs, out_dir: str) -> str:
+    """Collect per-process trace directories into one TensorBoard logdir
+    (the reference's multi-rank trace merge, ref utils.py:370-502: chrome
+    traces gathered to rank 0 with pid/tid remapping). The xplane format
+    needs no event rewriting — TensorBoard renders every host found under
+    one logdir — so the merge is a process-tagged relocation of each
+    host's `plugins/profile` runs."""
+    import shutil
+
+    os.makedirs(out_dir, exist_ok=True)
+    merged = []
+    for pid, src in enumerate(per_process_dirs):
+        prof_root = os.path.join(src, "plugins", "profile")
+        if not os.path.isdir(prof_root):
+            continue
+        for run in sorted(os.listdir(prof_root)):
+            dst = os.path.join(out_dir, "plugins", "profile",
+                               f"{run}_p{pid}")
+            shutil.copytree(os.path.join(prof_root, run), dst,
+                            dirs_exist_ok=True)
+            merged.append(dst)
+    if not merged:
+        raise FileNotFoundError(
+            f"no plugins/profile runs found under {list(per_process_dirs)}"
+        )
+    return out_dir
