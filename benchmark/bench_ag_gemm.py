@@ -42,9 +42,11 @@ DT = jnp.bfloat16 if ON_TPU else jnp.float32
 K_HI = 101 if ON_TPU else 3
 
 
-def _time(fn, a, b):
+def _time(fn, a, b, a_spec=None):
     """Chain-timed per-iteration latency: k data-dependent calls inside
-    one jit (RTT-proof; see runtime.utils.chain_timer)."""
+    one jit (RTT-proof; see runtime.utils.chain_timer). a_spec overrides
+    the activation sharding (P(None) = pre-gathered/replicated)."""
+    a_spec = P("tp") if a_spec is None else a_spec
 
     def build(k):
         def per_rank(a, b):
@@ -58,33 +60,11 @@ def _time(fn, a, b):
             return jnp.sum(out.astype(jnp.float32)).reshape(1)
 
         return jax.jit(jax.shard_map(
-            per_rank, mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+            per_rank, mesh=mesh, in_specs=(a_spec, P(None, "tp")),
             out_specs=P("tp"), check_vma=False,
         ))
 
     ms, _ = chain_timer(build, (a, b), k_hi=K_HI,
-                        pairs=7 if ON_TPU else 2, warmup=2)
-    return ms
-
-
-def _time_gemm_only(a_full, b):
-    """dot on the PRE-gathered activation: the pure-GEMM share."""
-
-    def build(k):
-        def per_rank(a, b):
-            def body(_, a):
-                c = jnp.dot(a, b, preferred_element_type=jnp.float32)
-                return (a * (1.0 + 0.0 * jnp.sum(c))).astype(a.dtype)
-
-            out = jax.lax.fori_loop(0, k, body, a)
-            return jnp.sum(out.astype(jnp.float32)).reshape(1)
-
-        return jax.jit(jax.shard_map(
-            per_rank, mesh=mesh, in_specs=(P(None), P(None, "tp")),
-            out_specs=P("tp"), check_vma=False,
-        ))
-
-    ms, _ = chain_timer(build, (a_full, b), k_hi=K_HI,
                         pairs=7 if ON_TPU else 2, warmup=2)
     return ms
 
@@ -103,7 +83,11 @@ def main():
 
         xla_ms = _time(lambda a, b: ag_gemm_ref(a, b, "tp"), a, b)
         ag_ms = _time(lambda a, b: ring_all_gather(a, "tp"), a, b)
-        gemm_ms = _time_gemm_only(a, b)  # a is already the full (M, K)
+        # pure-GEMM share: dot on the PRE-gathered (replicated) activation
+        gemm_ms = _time(
+            lambda a, b: jnp.dot(
+                a, b, preferred_element_type=jnp.float32).astype(DT),
+            a, b, a_spec=P(None))
         fused_ms = _time(
             lambda a, b: ag_gemm(a, b, "tp", config=cfg,
                                  force_kernel=True), a, b)

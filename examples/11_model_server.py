@@ -1,0 +1,89 @@
+"""Tutorial 11 — model server: serving decode over a socket.
+
+Port of the reference's megakernel model server + chat client
+(ref: mega_triton_kernel/test/models/model_server.py:112-193 socket
+server, chat.py): a server process owns the compiled engine and replays
+the jit'd decode step per request; clients send token ids over a local
+socket and stream back generated ids. Here the server runs in a thread
+(one process owns the TPU/mesh; the socket is the serving boundary).
+
+Run:  python examples/11_model_server.py [--tpu]
+"""
+
+import json
+import socket
+import threading
+
+import numpy as np
+from common import bootstrap
+
+jax, mesh = bootstrap(world=4)
+
+from triton_dist_tpu.models import Engine, ModelConfig  # noqa: E402
+
+GEN = 6
+
+
+def serve(sock, eng):
+    """Accept {\"ids\": [[...]]} JSON lines; reply {\"gen\": [[...]]} (or
+    {\"error\": ...} so the client never hangs on a server fault)."""
+    while True:
+        conn, _ = sock.accept()
+        with conn:
+            f = conn.makefile("rw")
+            line = f.readline()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+                if req.get("op") == "stop":
+                    return
+                ids = np.asarray(req["ids"], np.int32)
+                out = eng.serve(ids, req.get("gen_len", GEN))
+                resp = {"gen": np.asarray(out).tolist()}
+            except Exception as e:  # surface to the client
+                import traceback
+
+                traceback.print_exc()
+                resp = {"error": str(e)[:300]}
+            f.write(json.dumps(resp) + "\n")
+            f.flush()
+
+
+def main():
+    cfg = ModelConfig.tiny(max_positions=32)
+    eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="ar",
+                 donate_cache=False, max_len=32)
+
+    sock = socket.socket()
+    sock.bind(("localhost", 0))
+    sock.listen()
+    port = sock.getsockname()[1]
+    t = threading.Thread(target=serve, args=(sock, eng), daemon=True)
+    t.start()
+
+    # chat client (ref chat.py): two requests over the socket
+    for prompt in ([[5, 3, 9, 2]], [[1, 1, 2, 8]]):
+        c = socket.create_connection(("localhost", port))
+        with c:
+            f = c.makefile("rw")
+            f.write(json.dumps({"ids": prompt, "gen_len": GEN}) + "\n")
+            f.flush()
+            resp = json.loads(f.readline())
+        gen = resp["gen"][0]
+        assert len(gen) == GEN
+        print(f"11 model server: prompt {prompt[0]} -> generated {gen}")
+
+    c = socket.create_connection(("localhost", port))
+    with c:
+        f = c.makefile("rw")
+        f.write(json.dumps({"op": "stop"}) + "\n")
+        f.flush()
+    t.join(timeout=10)
+    print("11 model server: served 2 requests over the socket — OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,8 +66,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("spawnonce", [0])
-def test_two_process_rendezvous_and_collectives(spawnonce, tmp_path):
+def test_two_process_rendezvous_and_collectives(tmp_path):
     port = _free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = []

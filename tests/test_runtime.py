@@ -86,3 +86,20 @@ def test_merge_traces(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         merge_traces([str(tmp_path / "empty")], str(tmp_path / "m2"))
+
+
+def test_discover_topology():
+    """Topology/bandwidth discovery (ref comm_perf_model.py:51-93)."""
+    from triton_dist_tpu.runtime import discover_topology, make_mesh
+
+    mesh = make_mesh((4,), ("tp",))
+    topo = discover_topology(mesh, measure=True, nbytes=64 << 10)
+    assert topo.chip.ici_links > 0
+    assert topo.axes["tp"].size == 4
+    assert topo.axes["tp"].model_gbps > 0
+    assert topo.axes["tp"].measured_gbps is not None
+    assert topo.axes["tp"].measured_gbps > 0
+    # world-1 axis: nothing to measure
+    m1 = make_mesh((1,), ("tp",))
+    t1 = discover_topology(m1, measure=True)
+    assert t1.axes["tp"].measured_gbps is None

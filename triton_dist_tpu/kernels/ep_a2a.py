@@ -50,6 +50,21 @@ class EPDispatch(NamedTuple):
 _FP8_MAX = 448.0  # e4m3 finite max
 
 
+def _byte_wire(payload_dtype) -> bool:
+    """True for the fp8 wire format; rejects unsupported widths loudly
+    (a silently-ignored payload_dtype would ship a full-width wire while
+    the caller believes it halved the ICI bytes)."""
+    if payload_dtype is None:
+        return False
+    if jnp.dtype(payload_dtype).itemsize != 1:
+        raise ValueError(
+            f"payload_dtype {jnp.dtype(payload_dtype).name} unsupported: "
+            "the quantized wire format requires a 1-byte dtype "
+            "(jnp.float8_e4m3fn) or None for the full-width x.dtype wire"
+        )
+    return True
+
+
 def _quantize_fp8(x):
     """Per-token e4m3 quantization -> (q (M, H) fp8, scale (M,) f32)
     (ref: the fp8 payload + scale plane of the LL dispatch,
@@ -97,7 +112,7 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
     w_flat = weights.reshape(-1)[order].astype(jnp.float32)
 
     h = x.shape[1]
-    if payload_dtype is not None and jnp.dtype(payload_dtype).itemsize == 1:
+    if _byte_wire(payload_dtype):
         # fp8 wire format: quantized tokens + bitcast (scale, expert id)
         q, scale = _quantize_fp8(x)
         h_pad = -(-(h + 8) // 128) * 128  # +8 byte columns of metadata
@@ -168,7 +183,7 @@ def ep_dispatch(
     recv, recv_counts = a2a(send_x, counts, axis)
     slot_idx = jnp.arange(capacity)[None, :]
     recv_valid = slot_idx < recv_counts[:, None]
-    if payload_dtype is not None and jnp.dtype(payload_dtype).itemsize == 1:
+    if _byte_wire(payload_dtype):
         meta = jax.lax.bitcast_convert_type(
             recv[..., h:h + 8], jnp.uint8
         ).reshape(n, capacity, 8)

@@ -140,6 +140,11 @@ class MegaQwen3:
         donate_cache: bool = True,
     ):
         assert not cfg.is_moe, "megakernel covers the dense decode graph"
+        n_ = int(mesh.shape[axis])
+        assert cfg.num_q_heads % n_ == 0 and cfg.num_kv_heads % n_ == 0, (
+            f"head counts ({cfg.num_q_heads}q/{cfg.num_kv_heads}kv) must "
+            f"divide the tp size {n_}"
+        )
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis

@@ -29,6 +29,13 @@ from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
 from triton_dist_tpu.runtime.utils import (  # noqa: F401
     dist_print,
     perf_func,
+    chain_timer,
     assert_allclose,
     group_profile,
+    merge_traces,
+)
+from triton_dist_tpu.runtime.topology import (  # noqa: F401
+    Topology,
+    discover_topology,
+    measure_axis_bandwidth,
 )
