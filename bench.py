@@ -46,8 +46,9 @@ from triton_dist_tpu.models.dense import cache_specs, forward, param_specs
 from triton_dist_tpu.runtime import make_mesh
 from triton_dist_tpu.runtime.utils import chain_timer as _chain_timer
 
-# ref megakernel.md:33 — Qwen3-8B decode bs=1 seq=1 ctx=512, 8x H800 TP=8
-_BASELINE_DECODE_MS = 3.33
+# ref megakernel.md:33-34 — decode bs=1 seq=1 ctx=512, 8x H800 TP=8
+_BASELINE_DECODE_MS = 3.33       # Qwen3-8B
+_BASELINE_DECODE_32B_MS = 7.41   # Qwen3-32B
 _BASELINE_MLP_MS = 0.8854  # ref e2e_dense.md:21, TP MLP M=2048, 8x H800
 
 TP = 8  # baseline TP degree; per-rank shard sizes below
@@ -69,15 +70,12 @@ def _shard_cfg():
     )
 
 
-def bench_mega_decode(mesh):
-    """The megakernel decode chain — the direct analog of the reference's
-    headline MegaTritonKernel metric (megakernel.md:33): the whole Qwen3-8B
-    per-rank decode layer stack as ONE persistent Pallas kernel per step
-    (scalar-prefetched work queue + lax.switch dispatch; mega/kernel.py)."""
+def _bench_mega(mesh, cfg, k_hi, pairs):
+    """Megakernel decode chain for one model config (the harness shared
+    by the 8B headline and the 32B bandwidth-efficiency metric)."""
     from jax.sharding import PartitionSpec as P  # noqa: F811
     from triton_dist_tpu.mega.qwen3 import MegaKVCache, MegaQwen3
 
-    cfg = _shard_cfg()
     eng = Engine(cfg, mesh, decode_mode="ar", max_len=CTX,
                  donate_cache=False, fast_init=True)
     _, cache = eng.prefill(np.zeros((1, CTX - 1), np.int32))
@@ -109,8 +107,51 @@ def bench_mega_decode(mesh):
 
     return _chain_timer(
         build, (eng.params, tok, mcache.k, mcache.v, mcache.length),
-        k_hi=41, pairs=7,
+        k_hi=k_hi, pairs=pairs,
     )
+
+
+def _decode_weight_bytes(cfg):
+    """Per-step streamed weight bytes (all layer weights + head)."""
+    h, d = cfg.hidden_size, cfg.head_dim
+    wqkv = (cfg.num_q_heads + 2 * cfg.num_kv_heads) * d
+    per_layer = h * wqkv + cfg.num_q_heads * d * h + \
+        h * 2 * cfg.intermediate_size + cfg.intermediate_size * h
+    total = cfg.num_layers * per_layer + h * cfg.vocab_size
+    return total * jnp.dtype(cfg.dtype).itemsize
+
+
+def _hbm_floor_ms(cfg):
+    from triton_dist_tpu.perf_model import detect_chip
+
+    return _decode_weight_bytes(cfg) / (detect_chip().hbm_gbps * 1e9) * 1e3
+
+
+def bench_mega_decode(mesh):
+    """The megakernel decode chain — the direct analog of the reference's
+    headline MegaTritonKernel metric (megakernel.md:33): the whole Qwen3-8B
+    per-rank decode layer stack as ONE persistent Pallas kernel per step
+    (scalar-prefetched work queue + lax.switch dispatch; mega/kernel.py)."""
+    return _bench_mega(mesh, _shard_cfg(), k_hi=41, pairs=7)
+
+
+def _cfg_32b():
+    return ModelConfig(
+        vocab_size=151_936 // TP, hidden_size=5120,
+        intermediate_size=25_600 // TP, num_layers=64,
+        num_q_heads=64 // TP, num_kv_heads=8 // TP, head_dim=128,
+        max_positions=CTX, dtype="bfloat16",
+    )
+
+
+def bench_mega_decode_32b(mesh):
+    """Qwen3-32B per-rank megakernel decode (ref megakernel.md:34:
+    7.41 ms on 8x H800 TP=8). The per-rank shard streams ~8 GB of weights
+    per step, so one v5e's HBM floor is ~10 ms — this metric CANNOT meet
+    the 8x H800 number on one chip (H800 HBM is 4x faster); it is
+    reported for bandwidth-efficiency tracking (measured vs the computed
+    floor), not as a target claim."""
+    return _bench_mega(mesh, _cfg_32b(), k_hi=21, pairs=5)
 
 
 def bench_decode(mesh):
@@ -244,6 +285,17 @@ def main():
         result["engine_decode_error"] = str(e)[:200]
 
     # Secondary metrics must never kill the primary one.
+    try:
+        ms32, _ = bench_mega_decode_32b(mesh)
+        result["mega_decode_qwen3_32b_ms"] = round(ms32, 4)
+        result["mega_32b_vs_baseline"] = round(
+            ms32 / _BASELINE_DECODE_32B_MS, 4)
+        # one-chip HBM floor for this shard: the bandwidth-efficiency
+        # context for the line above (computed, not hardcoded)
+        result["mega_32b_hbm_floor_ms"] = round(
+            float(_hbm_floor_ms(_cfg_32b())), 4)
+    except Exception as e:
+        result["mega_32b_error"] = str(e)[:200]
     try:
         rng = np.random.default_rng(0)
         dt = jnp.bfloat16

@@ -45,6 +45,7 @@ from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
 from triton_dist_tpu.kernels.low_latency_allgather import (  # noqa: F401
     create_ll_ag_buffer,
     ll_all_gather,
+    ll_all_gather_op,
 )
 from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
     all_to_all,

@@ -54,9 +54,19 @@ _ONE_SHOT_VMEM_BUDGET = 14 << 20
 
 
 def choose_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
-    if nbytes <= _ONE_SHOT_MAX_BYTES:
-        return AllReduceMethod.OneShot
-    return AllReduceMethod.TwoShot
+    """Size/topology selection (ref auto-select, allreduce.py:1101-1126),
+    backed by the analytic perf model: one-shot pays n-1 full-tensor
+    sends (latency-optimal), two-shot is RS+AG (bandwidth-optimal); below
+    the crossover the model favors one-shot, and a hard byte cap keeps
+    the one-shot VMEM residents compilable."""
+    from triton_dist_tpu.perf_model import estimate_ar_ms
+
+    if nbytes > _ONE_SHOT_MAX_BYTES:
+        return AllReduceMethod.TwoShot
+    one = estimate_ar_ms(nbytes, n, method="one_shot")
+    two = estimate_ar_ms(nbytes, n, method="two_shot")
+    return (AllReduceMethod.OneShot if one <= two
+            else AllReduceMethod.TwoShot)
 
 
 def _one_shot_ar_kernel(axis: str, n: int, x_ref, o_ref, ws, acc, ld_sem,

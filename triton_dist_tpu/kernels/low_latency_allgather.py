@@ -95,6 +95,10 @@ def ll_all_gather(
         jnp.asarray(call_count % 2, jnp.int32),
         jnp.asarray(call_count == 0, jnp.int32),
     ])
+    return _ll_ag_call(flags, x, buf, call_count % 2, axis, n)
+
+
+def _ll_ag_call(flags, x, buf, parity, axis, n):
     kernel = functools.partial(_ll_ag_kernel, axis, n)
     buf = tpu_call(
         kernel,
@@ -116,5 +120,57 @@ def ll_all_gather(
             collective_id=next_collective_id(f"ll_ag_{axis}"),
         ),
     )(flags, x, buf)
-    parity = call_count % 2
     return jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False), buf
+
+
+_LL_OP_CACHE: dict = {}
+
+
+def _ll_op_fn(mesh, axis: str):
+    """Cached jitted executable per (mesh, axis): call_count rides as a
+    traced argument, so every decode step replays one compiled program
+    (a fresh closure per call would retrace — the opposite of
+    low-latency)."""
+    key = (mesh, axis)
+    if key not in _LL_OP_CACHE:
+        from jax.sharding import PartitionSpec as P
+
+        def per_device(x_shard, buf_shard, cc):
+            out, new_buf = ll_all_gather(x_shard, buf_shard[0], cc, axis)
+            return out, new_buf[None]
+
+        _LL_OP_CACHE[key] = jax.jit(
+            jax.shard_map(
+                per_device, mesh=mesh,
+                in_specs=(P(axis), P(axis), P()),
+                out_specs=(P(None, axis), P(axis)),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+    return _LL_OP_CACHE[key]
+
+
+def ll_all_gather_op(
+    x: jax.Array,
+    workspace,
+    call_count: int,
+    mesh,
+    axis: str = TP_AXIS,
+    name: str = "ll_ag",
+):
+    """Host-level LL allgather over a SymmetricWorkspace-owned context
+    (the reference's FastAllGatherContext held by a layer context and
+    reused across calls, low_latency_allgather.py:781 +
+    runtime/symm_mem.SymmetricWorkspace). x is a GLOBAL array sharded
+    P(axis); the context buffer persists inside `workspace` between jit
+    invocations (donated in, aliased out, stored back via update())."""
+    n = int(mesh.shape[axis])
+    loc_rows = x.shape[0] // n
+    local_shape = (2, n, loc_rows) + tuple(x.shape[1:])
+    buf = workspace.get(name, local_shape, x.dtype)
+    out, new_buf = _ll_op_fn(mesh, axis)(
+        x, buf, jnp.asarray(call_count, jnp.int32)
+    )
+    workspace.update(name, new_buf)
+    return out

@@ -143,7 +143,7 @@ class MegaQwen3:
         n_ = int(mesh.shape[axis])
         assert cfg.num_q_heads % n_ == 0 and cfg.num_kv_heads % n_ == 0, (
             f"head counts ({cfg.num_q_heads}q/{cfg.num_kv_heads}kv) must "
-            f"divide the tp size {n_}"
+            f"be divisible by the tp size {n_}"
         )
         self.cfg = cfg
         self.mesh = mesh
