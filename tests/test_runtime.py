@@ -93,12 +93,15 @@ def test_discover_topology():
     from triton_dist_tpu.runtime import discover_topology, make_mesh
 
     mesh = make_mesh((4,), ("tp",))
-    try:
-        topo = discover_topology(mesh, measure=True, nbytes=64 << 10)
-    except RuntimeError:
-        # chain_timer deliberately raises on non-positive medians; on a
-        # loaded CI host the sub-ms CPU chains can hit scheduler noise —
-        # fall back to asserting the model path only
+    topo = None
+    for _ in range(2):  # sub-ms CPU chains can hit scheduler noise
+        try:
+            topo = discover_topology(mesh, measure=True, nbytes=64 << 10)
+            break
+        except RuntimeError as e:
+            if "measurement failed" not in str(e):
+                raise  # a real bug in the measure path, not timing noise
+    if topo is None:
         topo = discover_topology(mesh, measure=False, nbytes=64 << 10)
     assert topo.chip.ici_links > 0
     assert topo.axes["tp"].size == 4

@@ -30,7 +30,7 @@ Bandwidth-class payloads want the ring/2-axis kernels in allgather.py.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -77,13 +77,16 @@ def ll_all_gather(
     buf: jax.Array,
     call_count,
     axis: str = TP_AXIS,
+    first=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Small-message AG: returns (gathered (n,)+x.shape, new buf).
 
     Per-device inside shard_map. `call_count` is the 0-based call index
-    on this context buffer (python int or traced scalar); call 0 performs
-    the one-time entry barrier. The context must not be shared by two
-    in-flight collectives."""
+    on this context buffer (python int or traced scalar); the FIRST call
+    on a fresh context performs the one-time entry barrier — by default
+    call 0, overridable via `first` (bool/scalar) when the caller manages
+    context lifetime separately from the call counter (ll_all_gather_op).
+    The context must not be shared by two in-flight collectives."""
     n = jax.lax.axis_size(axis)
     if n == 1:
         return x[None], buf
@@ -91,9 +94,11 @@ def ll_all_gather(
         return jax.lax.all_gather(x, axis), buf
 
     call_count = jnp.asarray(call_count, jnp.int32)
+    if first is None:
+        first = call_count == 0
     flags = jnp.stack([
         jnp.asarray(call_count % 2, jnp.int32),
-        jnp.asarray(call_count == 0, jnp.int32),
+        jnp.asarray(first, jnp.int32),
     ])
     return _ll_ag_call(flags, x, buf, call_count % 2, axis, n)
 
@@ -123,32 +128,28 @@ def _ll_ag_call(flags, x, buf, parity, axis, n):
     return jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False), buf
 
 
-_LL_OP_CACHE: dict = {}
-
-
+@functools.lru_cache(maxsize=None)
 def _ll_op_fn(mesh, axis: str):
-    """Cached jitted executable per (mesh, axis): call_count rides as a
-    traced argument, so every decode step replays one compiled program
-    (a fresh closure per call would retrace — the opposite of
-    low-latency)."""
-    key = (mesh, axis)
-    if key not in _LL_OP_CACHE:
-        from jax.sharding import PartitionSpec as P
+    """Cached jitted executable per (mesh, axis): call_count and the
+    fresh-context flag ride as traced arguments, so every decode step
+    replays one compiled program (a fresh closure per call would
+    retrace — the opposite of low-latency)."""
+    from jax.sharding import PartitionSpec as P
 
-        def per_device(x_shard, buf_shard, cc):
-            out, new_buf = ll_all_gather(x_shard, buf_shard[0], cc, axis)
-            return out, new_buf[None]
+    def per_device(x_shard, buf_shard, cc, first):
+        out, new_buf = ll_all_gather(x_shard, buf_shard[0], cc, axis,
+                                     first=first)
+        return out, new_buf[None]
 
-        _LL_OP_CACHE[key] = jax.jit(
-            jax.shard_map(
-                per_device, mesh=mesh,
-                in_specs=(P(axis), P(axis), P()),
-                out_specs=(P(None, axis), P(axis)),
-                check_vma=False,
-            ),
-            donate_argnums=(1,),
-        )
-    return _LL_OP_CACHE[key]
+    return jax.jit(
+        jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=(P(None, axis), P(axis)),
+            check_vma=False,
+        ),
+        donate_argnums=(1,),
+    )
 
 
 def ll_all_gather_op(
@@ -168,9 +169,13 @@ def ll_all_gather_op(
     n = int(mesh.shape[axis])
     loc_rows = x.shape[0] // n
     local_shape = (2, n, loc_rows) + tuple(x.shape[1:])
+    # the entry barrier keys off CONTEXT creation, not call_count: a new
+    # shape/name at a nonzero count still needs the one-time team sync
+    fresh = not workspace.contains(name, local_shape, x.dtype)
     buf = workspace.get(name, local_shape, x.dtype)
     out, new_buf = _ll_op_fn(mesh, axis)(
-        x, buf, jnp.asarray(call_count, jnp.int32)
+        x, buf, jnp.asarray(call_count, jnp.int32),
+        jnp.asarray(fresh, jnp.int32),
     )
     workspace.update(name, new_buf)
     return out

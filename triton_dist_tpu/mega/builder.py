@@ -11,7 +11,8 @@ analytic perf model so the (multi-core) scheduler can load-balance by
 critical path.
 
 Dynamic-arg conventions per op (queue row = [branch, a0..a5]):
-  matmul        [layer, src_buf, dst_buf]
+  matmul        [layer, src_buf, dst_buf, norm_row]  (norm_row used by
+                the "rms" prologue; branch key carries (prologue, eps))
   rms_norm      [norm_row, src_buf, dst_buf]
   silu_mul      [src_buf, dst_buf]
   add           [a_buf, b_buf, dst_buf]
@@ -77,19 +78,40 @@ class ModelBuilder:
         n_cols: int,
         dst: Optional[BufferHandle] = None,
         tag: str = "",
+        prologue: Optional[str] = None,
+        eps: float = 0.0,
+        norm_row: int = 0,
     ) -> BufferHandle:
-        """dst(B, n_cols) = src(B, k) @ weights[wname][layer] (k, n_cols).
-        (ref: make_qkv_proj/make_o_proj/make_mlp_fc, model_builder.py:189-300)
-        """
+        """dst(B, n_cols) = prologue(src) @ weights[wname][layer].
+        (ref: make_qkv_proj/make_o_proj/make_mlp_fc, model_builder.py:189-300;
+        fused prologues mirror the ref's fused task kernels,
+        mega kernels/mlp_fc1.py — see kernel._matmul_branch)."""
         dst = dst or self.buffer(n_cols, tag or wname)
         self.graph.add_task(
-            "matmul", ("matmul", wname, k, n_cols),
-            [layer, src.id, dst.id],
+            "matmul", ("matmul", wname, k, n_cols, prologue, eps),
+            [layer, src.id, dst.id, norm_row],
             reads=[src], writes=[dst],
             cost=estimate_gemm_ms(self.batch, n_cols, k, chip=self._chip),
             tag=tag or f"{wname}[{layer}]", buf_args=(1, 2),
         )
         return dst
+
+    def make_rms_matmul(self, wname, layer, src, k, n_cols, norm_row,
+                        eps, dst=None, tag=""):
+        """Fused rms_norm(src) @ W (saves one task + HBM round trip)."""
+        return self.make_matmul(wname, layer, src, k, n_cols, dst=dst,
+                                tag=tag or f"rms+{wname}[{layer}]",
+                                prologue="rms", eps=eps,
+                                norm_row=norm_row)
+
+    def make_act_matmul(self, wname, layer, src, inter, n_cols,
+                        dst=None, tag=""):
+        """Fused (silu(gate) * up) @ W: src is the (B, 2*inter) gate_up
+        output, contract dim = inter."""
+        return self.make_matmul(wname, layer, src, inter, n_cols,
+                                dst=dst,
+                                tag=tag or f"silu+{wname}[{layer}]",
+                                prologue="silu")
 
     def make_rms_norm(
         self,

@@ -121,10 +121,19 @@ class _Env:
 
 
 def _matmul_branch(key, env: _Env):
-    _, wname, K, N = key
+    """Tiled matmul with an optional fused input prologue (the
+    reference's fused task kernels, mega kernels/mlp_fc1.py: norm or
+    activation computed in-register on the loaded input instead of
+    round-tripping through a separate task + HBM slot — at decode shapes
+    the saved task boundaries are a measurable share of the step).
+
+    prologue: None · "rms" (input rms-norm, per-task norm row in a3) ·
+    "silu" (input is [gate|up] of width 2K; a = silu(gate) * up)."""
+    _, wname, K, N, prologue, eps = key
     TN = _fit_tile(N)
     nt = N // TN
     w_ref = env.weights[wname]
+    in_w = 2 * K if prologue == "silu" else K
 
     def wcopy(layer, j, slot):
         return pltpu.make_async_copy(
@@ -134,14 +143,31 @@ def _matmul_branch(key, env: _Env):
         )
 
     def body(args):
-        layer, src, dst = args[0], args[1], args[2]
+        layer, src, dst, nrow = args[0], args[1], args[2], args[3]
         cp_in = pltpu.make_async_copy(
-            env.ws_rows(src, K), env.vin.at[:, pl.ds(0, K)], env.ld1
+            env.ws_rows(src, in_w), env.vin.at[:, pl.ds(0, in_w)], env.ld1
         )
         cp_in.start()
         wcopy(layer, 0, 0).start()
+        if prologue == "rms":
+            cp_w = pltpu.make_async_copy(
+                env.norms.at[pl.ds(nrow * 8, 8)], env.vnq, env.ld2
+            )
+            cp_w.start()
         cp_in.wait()
-        a = env.vin[:, :K]
+        if prologue == "rms":
+            cp_w.wait()
+            x = env.vin[:, :K].astype(jnp.float32)
+            w = env.vnq[0, :K].astype(jnp.float32)
+            var = jnp.mean(x * x, axis=-1, keepdims=True)
+            a = (x * jax.lax.rsqrt(var + eps) * w[None, :]).astype(
+                env.dtype)
+        elif prologue == "silu":
+            g = env.vin[:, :K].astype(jnp.float32)
+            u = env.vin[:, K:2 * K].astype(jnp.float32)
+            a = (g * jax.nn.sigmoid(g) * u).astype(env.dtype)
+        else:
+            a = env.vin[:, :K]
         for j in range(nt):
             if j + 1 < nt:
                 wcopy(layer, j + 1, (j + 1) % 2).start()
@@ -562,6 +588,7 @@ def compile_graph(
     world = max((k[-1] for k in ar_keys), default=1)
     weight_names = sorted({k[1] for k in mm_keys})
     norm_ws = [k[1] for k in branch_keys if k[0] == "rms_norm"]
+    norm_ws += [k[2] for k in mm_keys if k[4] == "rms"]
     if any(k[6] for k in at_keys):  # use_qk_norm
         norm_ws.append(D)
     norm_width = round_up(max(norm_ws, default=128), 128)

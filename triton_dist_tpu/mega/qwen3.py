@@ -92,8 +92,8 @@ def build_qwen3_graph(
     mb.make_barrier()
     kn_bufs, vn_bufs = [], []
     for l in range(L):
-        h1 = mb.make_rms_norm(l, x, H, cfg.rms_eps, tag=f"ln1[{l}]")
-        qkv = mb.make_matmul("w_qkv", l, h1, H, wqkv, tag=f"qkv[{l}]")
+        qkv = mb.make_rms_matmul("w_qkv", l, x, H, wqkv, norm_row=l,
+                                 eps=cfg.rms_eps, tag=f"ln1+qkv[{l}]")
         attn, kn, vn = mb.make_attention(
             l, qkv, hq_l, hkv_l, D, s_max, cfg.rms_eps, cfg.use_qk_norm,
             q_norm_base=2 * L + 1, k_norm_base=3 * L + 1,
@@ -102,11 +102,11 @@ def build_qwen3_graph(
         vn_bufs.append(vn)
         o = mb.make_matmul("w_o", l, attn, hq_l * D, H, tag=f"o[{l}]")
         x = mb.make_allreduce_add(o, x, H, tag=f"ar_attn[{l}]")
-        h2 = mb.make_rms_norm(L + l, x, H, cfg.rms_eps, tag=f"ln2[{l}]")
-        gu = mb.make_matmul("w_gate_up", l, h2, H, 2 * i_l,
-                            tag=f"gate_up[{l}]")
-        act = mb.make_silu_mul(gu, i_l)
-        dn = mb.make_matmul("w_down", l, act, i_l, H, tag=f"down[{l}]")
+        gu = mb.make_rms_matmul("w_gate_up", l, x, H, 2 * i_l,
+                                norm_row=L + l, eps=cfg.rms_eps,
+                                tag=f"ln2+gate_up[{l}]")
+        dn = mb.make_act_matmul("w_down", l, gu, i_l, H,
+                                tag=f"silu+down[{l}]")
         x = mb.make_allreduce_add(dn, x, H, tag=f"ar_mlp[{l}]")
     final = mb.make_rms_norm(2 * L, x, H, cfg.rms_eps, tag="final_ln")
     mb.graph.pinned[final.id] = True

@@ -71,6 +71,11 @@ class SymmetricWorkspace:
     axis: str = TP_AXIS
     _buffers: dict = field(default_factory=dict)
 
+    def contains(self, name: str, local_shape: Tuple[int, ...],
+                 dtype=jnp.float32) -> bool:
+        key = (name, tuple(local_shape), jnp.dtype(dtype).name)
+        return key in self._buffers
+
     def get(self, name: str, local_shape: Tuple[int, ...], dtype=jnp.float32):
         key = (name, tuple(local_shape), jnp.dtype(dtype).name)
         if key not in self._buffers:
