@@ -59,7 +59,9 @@ def kernel(axis, n, x_ref, q_ref, send_sem, recv_sem):
 
 def main():
     n = int(mesh.shape["tp"])
-    assert n >= 2, "needs 2 devices"
+    if n < 2:
+        print("01 notify/wait: needs >= 2 devices; skipping on 1-chip")
+        return
     x = jnp.arange(n * QUEUE * ROWS * COLS, dtype=jnp.float32).reshape(
         n * QUEUE, ROWS, COLS
     )

@@ -45,8 +45,9 @@ def main():
         in_specs=(P(None, "tp"), P(None, "tp"), P(None, "tp")),
         out_specs=P(None, "tp"), check_vma=False,
     ))(q, k, v)
+    tol = 2e-2 if jax.devices()[0].platform == "tpu" else 2e-4
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
+                               rtol=tol, atol=tol)
     print(f"09a ring attention (SP prefill): OK (seq {n * T} over {n})")
 
     # decode: KV cache sequence-sharded; q replicated
@@ -69,8 +70,8 @@ def main():
         p = np.exp(lg - lg.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
         want[:, h] = np.einsum("bt,btd->bd", p, vf[:, :, h // g])
-    np.testing.assert_allclose(np.asarray(outd), want, rtol=2e-4,
-                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(outd), want, rtol=tol,
+                               atol=tol)
     print(f"09b distributed flash-decode: OK (cache {n * T} over {n})")
 
 

@@ -23,7 +23,15 @@ GEN = 5
 
 def main():
     n = int(mesh.shape["tp"])
-    cfg = ModelConfig.tiny(max_positions=32)
+    if jax.devices()[0].platform == "tpu":
+        # native Mosaic needs lane-width heads (see mega/qwen3.py)
+        cfg = ModelConfig.tiny(
+            max_positions=32, head_dim=128,
+            num_q_heads=2 * max(n, 2), num_kv_heads=max(n, 2),
+            hidden_size=256, intermediate_size=512,
+        )
+    else:
+        cfg = ModelConfig.tiny(max_positions=32)
     eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="xla",
                  donate_cache=False, max_len=32)
     prompt = np.array([[5, 3, 9, 2], [1, 1, 2, 8], [7, 0, 4, 4],

@@ -94,3 +94,58 @@ def test_mega_greedy_matches_engine(tiny_cfg):
         toks_e.append(np.asarray(tok_e))
         toks_m.append(np.asarray(tok_m))
     np.testing.assert_array_equal(np.stack(toks_e), np.stack(toks_m))
+
+
+def test_standalone_op_branches_mlp_graph():
+    """The standalone rms_norm / silu_mul / add / matmul branches stay
+    exercised (the Qwen3 graph now uses fused prologues; these ops remain
+    library surface for custom graphs — ref: mega test/ops/*)."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.mega.builder import ModelBuilder
+    from triton_dist_tpu.mega.kernel import compile_graph
+    from triton_dist_tpu.mega.scheduler import schedule_graph, validate_schedule
+
+    B, H, I = 2, 128, 256
+    mb = ModelBuilder(batch=B, world=1)
+    x = mb.buffer(H, "x", pinned=True)
+    h1 = mb.make_rms_norm(0, x, H, 1e-6)
+    gu = mb.make_matmul("w_gate_up", 0, h1, H, 2 * I)
+    act = mb.make_silu_mul(gu, I)
+    dn = mb.make_matmul("w_down", 0, act, I, H)
+    out = mb.make_add(dn, x, H)
+    mb.graph.pinned[out.id] = True
+
+    sched = schedule_graph(mb.graph)
+    validate_schedule(mb.graph, sched)
+    cm = compile_graph(mb.graph, sched, jnp.float32, name="mega_ops_test")
+    assert {k[0] for k in cm.branch_keys} == {
+        "rms_norm", "matmul", "silu_mul", "add"}
+
+    rng = np.random.default_rng(0)
+    xv = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((1, H, 2 * I)) * 0.05, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((1, I, H)) * 0.05, jnp.float32)
+    norms = jnp.repeat(jnp.ones((1, cm.norm_width), jnp.float32), 8, 0)
+
+    ws = cm.workspace(jnp.float32)
+    xs = int(sched.buf_slot[x.id]) * cm.pb
+    ws = ws.at[xs:xs + B, :H].set(xv)
+    pos = jnp.zeros((B,), jnp.int32)
+    dummy = jnp.zeros((8, 128), jnp.float32)
+    kc = jnp.zeros((1, 1, B, 8, 128), jnp.float32)
+
+    ws_o = jax.jit(lambda *a: cm.run(*a))(
+        pos, ws, {"w_gate_up": wg, "w_down": wd}, norms, dummy, kc, kc)
+    slot = int(sched.buf_slot[out.id]) * cm.pb
+    got = ws_o[slot:slot + B, :H]
+
+    def ref(x):
+        v = jnp.mean(x * x, -1, keepdims=True)
+        h = x * jax.lax.rsqrt(v + 1e-6)
+        g = h @ wg[0]
+        a = g[:, :I] * jax.nn.sigmoid(g[:, :I]) * g[:, I:]
+        return a @ wd[0] + x
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref(xv)),
+                               rtol=2e-4, atol=2e-4)

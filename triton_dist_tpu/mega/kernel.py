@@ -117,6 +117,20 @@ class _Env:
         return self.ws.at[pl.ds(slot * self.pb, self.pb), pl.ds(0, width)]
 
 
+# -- shared op math (one definition: fused and standalone branches must
+# never diverge — the e2e tests compare their outputs token-for-token) ---
+
+
+def _rms_f32(x, w, eps):
+    """rms_norm in f32: x (B, W) value, w (W,) value."""
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * w[None, :]
+
+
+def _silu_f32(g, u):
+    return g * jax.nn.sigmoid(g) * u
+
+
 # -- branch builders (one per op kind; key carries the static config) --------
 
 
@@ -157,15 +171,15 @@ def _matmul_branch(key, env: _Env):
         cp_in.wait()
         if prologue == "rms":
             cp_w.wait()
-            x = env.vin[:, :K].astype(jnp.float32)
-            w = env.vnq[0, :K].astype(jnp.float32)
-            var = jnp.mean(x * x, axis=-1, keepdims=True)
-            a = (x * jax.lax.rsqrt(var + eps) * w[None, :]).astype(
-                env.dtype)
+            a = _rms_f32(
+                env.vin[:, :K].astype(jnp.float32),
+                env.vnq[0, :K].astype(jnp.float32), eps,
+            ).astype(env.dtype)
         elif prologue == "silu":
-            g = env.vin[:, :K].astype(jnp.float32)
-            u = env.vin[:, K:2 * K].astype(jnp.float32)
-            a = (g * jax.nn.sigmoid(g) * u).astype(env.dtype)
+            a = _silu_f32(
+                env.vin[:, :K].astype(jnp.float32),
+                env.vin[:, K:2 * K].astype(jnp.float32),
+            ).astype(env.dtype)
         else:
             a = env.vin[:, :K]
         for j in range(nt):
@@ -203,10 +217,8 @@ def _rms_norm_branch(key, env: _Env):
         cp_w.start()
         cp_in.wait()
         cp_w.wait()
-        x = env.vin[:, :W].astype(jnp.float32)
-        w = env.vnq[0, :W].astype(jnp.float32)
-        var = jnp.mean(x * x, axis=-1, keepdims=True)
-        y = x * jax.lax.rsqrt(var + eps) * w[None, :]
+        y = _rms_f32(env.vin[:, :W].astype(jnp.float32),
+                     env.vnq[0, :W].astype(jnp.float32), eps)
         env.vout[:, :W] = y.astype(env.dtype)
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
@@ -227,9 +239,8 @@ def _silu_mul_branch(key, env: _Env):
         )
         cp_in.start()
         cp_in.wait()
-        g = env.vin[:, :I].astype(jnp.float32)
-        u = env.vin[:, I:2 * I].astype(jnp.float32)
-        y = g * jax.nn.sigmoid(g) * u
+        y = _silu_f32(env.vin[:, :I].astype(jnp.float32),
+                      env.vin[:, I:2 * I].astype(jnp.float32))
         env.vout[:, :I] = y.astype(env.dtype)
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, I)], env.ws_rows(dst, I), env.st

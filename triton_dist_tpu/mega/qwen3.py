@@ -140,6 +140,16 @@ class MegaQwen3:
         donate_cache: bool = True,
     ):
         assert not cfg.is_moe, "megakernel covers the dense decode graph"
+        from triton_dist_tpu.lang.core import use_interpret
+
+        if not use_interpret() and cfg.head_dim % 128 != 0:
+            # the attention branch reshapes (B, H*D) -> (B, H, D): native
+            # Mosaic only supports this when the minor dim is lane-width
+            raise ValueError(
+                f"megakernel on native TPU requires head_dim % 128 == 0 "
+                f"(got {cfg.head_dim}); sub-lane head dims run in "
+                "interpret mode only"
+            )
         n_ = int(mesh.shape[axis])
         assert cfg.num_q_heads % n_ == 0 and cfg.num_kv_heads % n_ == 0, (
             f"head counts ({cfg.num_q_heads}q/{cfg.num_kv_heads}kv) must "
