@@ -66,7 +66,12 @@ from triton_dist_tpu.lang.core import (
 from triton_dist_tpu.mega.core import Graph
 from triton_dist_tpu.mega.scheduler import Schedule
 
-ROW = 7  # queue row: [branch, a0..a5]
+ROW = 10  # queue row: [branch, a0..a5, pf_code, pf_layer, pf_in]
+# pf_*: cross-task weight prefetch (the reference's prefetch tasks, mega
+# kernels/prefetch.py, made implicit): the scheduler knows the next task
+# statically, so each row carries the NEXT matmul's weight id+layer; the
+# running task starts that first tile's DMA as its last act, and the next
+# matmul (pf_in=1) consumes it instead of issuing a cold load.
 
 
 def _fit_tile(n: int, cap: int = 512) -> int:
@@ -104,6 +109,9 @@ class _Env:
     vrope: Any = None
     vnq: Any = None
     vnk: Any = None
+    vpf: Any = None
+    pfsem: Any = None
+    pf_specs: Any = None  # [(wname, K, TN)] in weight-name order
     mailbox: Any = None
     ld1: Any = None
     ld2: Any = None
@@ -134,6 +142,29 @@ def _silu_f32(g, u):
 # -- branch builders (one per op kind; key carries the static config) --------
 
 
+def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int):
+    """THE prefetch descriptor: start (issuer) and wait (consumer) must
+    reconstruct it identically for the semaphore accounting to balance —
+    single construction site for both."""
+    return pltpu.make_async_copy(
+        env.weights[wname].at[layer, :, pl.ds(0, TN)],
+        env.vpf.at[pl.ds(0, K), pl.ds(0, TN)],
+        env.pfsem,
+    )
+
+
+def _maybe_prefetch(env: _Env, pf_code, pf_layer):
+    """Start the next matmul's first weight tile (hinted by the queue
+    row). Branches that mark handles_prefetch issue it before their final
+    store drain (overlapping it); the dispatch wrapper covers the rest as
+    the task's final act (overlapping only the next task's input load)."""
+    for wi, (wname, K, TN) in enumerate(env.pf_specs):
+        @pl.when(pf_code == wi + 1)
+        def _(wname=wname, K=K, TN=TN):
+            _pf_copy(env, wname, pf_layer, K, TN).start()
+
+
+
 def _matmul_branch(key, env: _Env):
     """Tiled matmul with an optional fused input prologue (the
     reference's fused task kernels, mega kernels/mlp_fc1.py: norm or
@@ -148,6 +179,8 @@ def _matmul_branch(key, env: _Env):
     nt = N // TN
     w_ref = env.weights[wname]
     in_w = 2 * K if prologue == "silu" else K
+    pf_eligible = any(w == wname and kk == K and tn == TN
+                      for w, kk, tn in env.pf_specs)
 
     def wcopy(layer, j, slot):
         return pltpu.make_async_copy(
@@ -158,11 +191,18 @@ def _matmul_branch(key, env: _Env):
 
     def body(args):
         layer, src, dst, nrow = args[0], args[1], args[2], args[3]
+        pf_in = args[8]
         cp_in = pltpu.make_async_copy(
             env.ws_rows(src, in_w), env.vin.at[:, pl.ds(0, in_w)], env.ld1
         )
         cp_in.start()
-        wcopy(layer, 0, 0).start()
+
+        if pf_eligible:
+            @pl.when(pf_in == 0)
+            def _cold_first_tile():
+                wcopy(layer, 0, 0).start()
+        else:
+            wcopy(layer, 0, 0).start()
         if prologue == "rms":
             cp_w = pltpu.make_async_copy(
                 env.norms.at[pl.ds(nrow * 8, 8)], env.vnq, env.ld2
@@ -185,9 +225,29 @@ def _matmul_branch(key, env: _Env):
         for j in range(nt):
             if j + 1 < nt:
                 wcopy(layer, j + 1, (j + 1) % 2).start()
-            wcopy(layer, j, j % 2).wait()
+            if j == 0:
+                if pf_eligible:
+                    def _from_prefetch():
+                        _pf_copy(env, wname, layer, K, TN).wait()
+                        return env.vpf[:K, :TN]
+
+                    def _from_cold():
+                        wcopy(layer, 0, 0).wait()
+                        return env.vw[0, :K, :TN]
+
+                    w_tile = jax.lax.cond(pf_in == 1, _from_prefetch,
+                                          _from_cold)
+                else:
+                    # weight excluded from prefetching (non-unique
+                    # (K, TN)): pf_in is statically never 1 for this
+                    # branch and vpf may be smaller than (K, TN)
+                    wcopy(layer, 0, 0).wait()
+                    w_tile = env.vw[0, :K, :TN]
+            else:
+                wcopy(layer, j, j % 2).wait()
+                w_tile = env.vw[j % 2, :K, :TN]
             acc = jax.lax.dot_general(
-                a, env.vw[j % 2, :K, :TN], (((1,), (0,)), ((), ())),
+                a, w_tile, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             env.vout[:, j * TN:(j + 1) * TN] = acc.astype(env.dtype)
@@ -195,8 +255,12 @@ def _matmul_branch(key, env: _Env):
             env.vout.at[:, pl.ds(0, N)], env.ws_rows(dst, N), env.st
         )
         st.start()
+        # issue the next task's weight prefetch BEFORE draining our store:
+        # the DMA rides the store wait + the next task's input load
+        _maybe_prefetch(env, args[6], args[7])
         st.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -498,9 +562,13 @@ def _attention_branch(key, env: _Env):
         ]
         for cp in cps:
             cp.start()
+        # the attention->o_proj edge is the hottest prefetch site: issue
+        # it before draining our three stores
+        _maybe_prefetch(env, args[6], args[7])
         for cp in cps:
             cp.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -574,6 +642,26 @@ def compile_graph(
             row[1 + pos_] = int(sched.buf_slot[row[1 + pos_]])
         queue[qi] = row[:ROW]
 
+    # cross-task weight prefetch hints (see ROW comment): a weight is
+    # prefetchable only when every matmul using it shares one (K, TN)
+    mm_keys_all = [t.branch_key for t in tasks if t.op == "matmul"]
+    name_dims: Dict[str, set] = {}
+    for k in mm_keys_all:
+        name_dims.setdefault(k[1], set()).add((k[2], _fit_tile(k[3])))
+    pf_specs = []
+    pf_code_of = {}
+    for name in sorted(name_dims):
+        if len(name_dims[name]) == 1:
+            (kk, tn), = name_dims[name]
+            pf_code_of[name] = len(pf_specs) + 1
+            pf_specs.append((name, kk, tn))
+    for qi in range(len(order) - 1):
+        nxt = tasks[order[qi + 1]]
+        if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
+            queue[qi, 7] = pf_code_of[nxt.branch_key[1]]
+            queue[qi, 8] = nxt.args[0]  # layer
+            queue[qi + 1, 9] = 1        # consumer: first tile prefetched
+
     # static dims
     wmax = round_up(max(b.width for b in graph.buffers), 128)
     for k in branch_keys:
@@ -604,9 +692,13 @@ def compile_graph(
         norm_ws.append(D)
     norm_width = round_up(max(norm_ws, default=128), 128)
 
+    pf_kmax = max((k for _, k, _ in pf_specs), default=8)
+    pf_tnmax = max((t for _, _, t in pf_specs), default=128)
+
     n_slots = sched.n_slots
     isz = jnp.dtype(dtype).itemsize
     vmem = (
+        pf_kmax * pf_tnmax * isz +
         4 * PB * wmax * max(isz, 4)
         + 2 * kmax * tnmax * isz
         + 2 * B * SMAX * D * isz
@@ -619,22 +711,30 @@ def compile_graph(
         w_refs = rest[:nw]
         (norms, rope_cs, k_cache, v_cache,
          ws_out,
-         vin, vin2, vout, vw, vkv, vrope, vnq, vnk, mailbox,
-         ld1, ld2, st, wsems, kvsem, send, recv) = rest[nw:]
+         vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
+         ld1, ld2, st, wsems, kvsem, send, recv, pfsem) = rest[nw:]
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
             ws=ws_out, weights=dict(zip(weight_names, w_refs)),
             norms=norms, rope_cs=rope_cs, k_cache=k_cache,
             v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
-            vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, mailbox=mailbox,
+            vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, vpf=vpf,
+            pfsem=pfsem, pf_specs=pf_specs, mailbox=mailbox,
             ld1=ld1, ld2=ld2,
             st=st, wsems=wsems, kvsem=kvsem, send=send, recv=recv,
         )
         bodies = [_BRANCH_BUILDERS[k[0]](k, env) for k in branch_keys]
         ti = pl.program_id(0)
         a = [q_ref[ti, j] for j in range(1, ROW)]
-        jax.lax.switch(q_ref[ti, 0], [lambda f=f: f(a) for f in bodies])
+
+        def dispatch(f):
+            f(a)
+            if not getattr(f, "handles_prefetch", False):
+                _maybe_prefetch(env, a[6], a[7])
+
+        jax.lax.switch(q_ref[ti, 0],
+                       [lambda f=f: dispatch(f) for f in bodies])
 
     def run(pos, ws, weights: Dict[str, jax.Array], norms, rope_cs,
             k, v):
@@ -657,6 +757,7 @@ def compile_graph(
                 # f32 8-row stripes (see _rms_norm_branch)
                 pltpu.VMEM((8, norm_width), jnp.float32),  # vnq
                 pltpu.VMEM((8, norm_width), jnp.float32),  # vnk
+                pltpu.VMEM((pf_kmax, pf_tnmax), dtype),  # vpf prefetch
                 pltpu.VMEM((2, world, PB, arw), dtype),  # AR mailbox
                 pltpu.SemaphoreType.DMA,                 # ld1
                 pltpu.SemaphoreType.DMA,                 # ld2
@@ -665,6 +766,7 @@ def compile_graph(
                 pltpu.SemaphoreType.DMA,                 # kvsem
                 pltpu.SemaphoreType.DMA,                 # send
                 pltpu.SemaphoreType.DMA,                 # recv
+                pltpu.SemaphoreType.DMA,                 # pfsem
             ],
         )
         fn = tpu_call(
