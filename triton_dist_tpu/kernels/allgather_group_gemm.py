@@ -26,7 +26,6 @@ from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
 from triton_dist_tpu.kernels.moe_utils import (
     ExpertSort,
     combine_topk,
-    sort_by_expert,
 )
 from triton_dist_tpu.kernels.reduce_scatter import (
     ReduceScatterMethod,

@@ -37,7 +37,6 @@ from triton_dist_tpu.lang.core import (
     tpu_call,
     compiler_params,
     next_collective_id,
-    cdiv,
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS

@@ -30,7 +30,7 @@ Bandwidth-class payloads want the ring/2-axis kernels in allgather.py.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

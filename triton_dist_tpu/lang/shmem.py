@@ -25,7 +25,7 @@ Semantics notes (differences from NVSHMEM, by design of the hardware):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Sequence, Union
 
 import jax
 from jax.experimental import pallas as pl

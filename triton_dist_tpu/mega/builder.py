@@ -25,7 +25,7 @@ Buffer-id args are rewritten to workspace slots at compile time
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 from triton_dist_tpu.mega.core import BufferHandle, Graph, Task
 from triton_dist_tpu.perf_model import (

@@ -15,7 +15,7 @@ the dependency is the whole buffer).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+from typing import Dict, Hashable, List, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,7 +23,7 @@ from __future__ import annotations
 import ctypes
 import dataclasses
 import heapq
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional
 
 import numpy as np
 

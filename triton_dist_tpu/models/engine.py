@@ -10,7 +10,6 @@ prefill-then-decode loop.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
