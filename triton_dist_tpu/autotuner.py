@@ -22,6 +22,7 @@ in-process and optionally on disk (TDT_AUTOTUNE_CACHE=path.json) keyed by
 from __future__ import annotations
 
 import dataclasses
+import enum
 import json
 import os
 import statistics
@@ -232,6 +233,27 @@ def gemm_rs_config_space():
     return [GemmRsConfig(tile_m=tm) for tm in (128, 256, 512, 1024)]
 
 
+def _default_key_part(argname, a):
+    """Stable cache-key fragment for one argument of an autotuned call.
+
+    Arrays key by shape+dtype; scalars/types/enums by value; anything
+    else by type name only — NOT default repr, which embeds the object
+    address and would turn every call into a cache miss. When kernel
+    behavior depends on such an object's *identity*, pass key_fn."""
+    if isinstance(a, type):  # incl. np/jnp scalar types (callable, and
+        # np ones carry a class-level `shape` descriptor: check first)
+        return (argname, f"{a.__module__}.{a.__qualname__}")
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return (argname, tuple(a.shape), str(a.dtype))
+    if a is None or isinstance(a, (bool, int, float, str, bytes)):
+        return (argname, repr(a))
+    if isinstance(a, enum.Enum):
+        return (argname, f"{type(a).__qualname__}.{a.name}")
+    if isinstance(a, (tuple, list)):
+        return (argname, tuple(_default_key_part("", x) for x in a))
+    return (argname, type(a).__qualname__)
+
+
 def autotune(
     name: str,
     configs: Sequence[Any],
@@ -253,10 +275,9 @@ def autotune(
                 key_fn(*args, **kwargs)
                 if key_fn is not None
                 else tuple(
-                    (name, tuple(a.shape), str(a.dtype))
-                    for name, a in list(enumerate(args))
+                    _default_key_part(argname, a)
+                    for argname, a in list(enumerate(args))
                     + sorted(kwargs.items())
-                    if hasattr(a, "shape")
                 )
             )
             result = tuner.tune(

@@ -25,6 +25,7 @@ Semantics notes (differences from NVSHMEM, by design of the hardware):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Sequence, Union
 
 import jax
@@ -319,6 +320,19 @@ def getmem_nbi(
     patterns from_pe = me+d it defaults to me-d; pass it explicitly for
     other permutations. The handle's wait_recv() is this rank's get
     completion."""
+    # reader_pe inference is valid ONLY for uniform ring shifts
+    # (from_pe = me+d with the same d on every rank). For any other
+    # permutation the inferred inverse targets the wrong rank and the
+    # failure is a silent corruption or hang — and shift-uniformity is
+    # not locally checkable (it is a property of from_pe across ranks).
+    # TDT_STRICT_GETMEM=1 turns omission into a trace-time error for
+    # code that cannot guarantee shift patterns.
+    if reader_pe is None and os.environ.get("TDT_STRICT_GETMEM") == "1":
+        raise ValueError(
+            "getmem_nbi: reader_pe not given and TDT_STRICT_GETMEM=1 — "
+            "the default inference is only correct for uniform ring "
+            "shifts; pass reader_pe (the inverse permutation) explicitly"
+        )
     me = my_pe(axis)
     n = n_pes(axis)
     if reader_pe is None:

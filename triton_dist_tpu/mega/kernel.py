@@ -375,10 +375,15 @@ def _allreduce_add_branch(key, env: _Env):
             handles = []
             for i in range(1, n):
                 peer = jax.lax.rem(me + i, n)
+                # recv is per-parity (DMA((2,))): under rank skew a fast
+                # peer's AR m+1 delivery must not satisfy this rank's AR m
+                # recv wait while a slow peer's AR m put is in flight —
+                # same misattribution low_latency_allgather.py documents,
+                # same fix (recv_sems.at[parity]).
                 h = shmem.putmem_nbi(
                     env.mailbox.at[parity, me, :, pl.ds(0, W)],
                     env.ws_rows(src, W),
-                    env.send, env.recv, peer, axis,
+                    env.send, env.recv.at[parity], peer, axis,
                 )
                 handles.append(h)
             cp_loc.wait()
@@ -650,11 +655,11 @@ def compile_graph(
         name_dims.setdefault(k[1], set()).add((k[2], _fit_tile(k[3])))
     pf_specs = []
     pf_code_of = {}
-    for name in sorted(name_dims):
-        if len(name_dims[name]) == 1:
-            (kk, tn), = name_dims[name]
-            pf_code_of[name] = len(pf_specs) + 1
-            pf_specs.append((name, kk, tn))
+    for wname in sorted(name_dims):
+        if len(name_dims[wname]) == 1:
+            (kk, tn), = name_dims[wname]
+            pf_code_of[wname] = len(pf_specs) + 1
+            pf_specs.append((wname, kk, tn))
     for qi in range(len(order) - 1):
         nxt = tasks[order[qi + 1]]
         if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
@@ -765,7 +770,7 @@ def compile_graph(
                 pltpu.SemaphoreType.DMA((2,)),           # wsems
                 pltpu.SemaphoreType.DMA,                 # kvsem
                 pltpu.SemaphoreType.DMA,                 # send
-                pltpu.SemaphoreType.DMA,                 # recv
+                pltpu.SemaphoreType.DMA((2,)),           # recv (per-parity)
                 pltpu.SemaphoreType.DMA,                 # pfsem
             ],
         )
