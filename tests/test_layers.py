@@ -161,7 +161,7 @@ def test_tp_mlp_sharded_modes_match_dense(mesh8, mode):
     )
 
     def per_rank(xs, w1, w2):
-        return tp_mlp_fwd(xs, TPMLPParams(w1[0], w2[0]), mode=mode)
+        return tp_mlp_fwd(xs, TPMLPParams.from_fused(w1[0], w2[0]), mode=mode)
 
     y = jax.jit(
         jax.shard_map(
@@ -185,7 +185,7 @@ def test_tp_mlp_ar_mode_matches_dense(mesh8):
     )
 
     def per_rank(xf, w1, w2):
-        return tp_mlp_fwd(xf, TPMLPParams(w1[0], w2[0]), mode="ar")
+        return tp_mlp_fwd(xf, TPMLPParams.from_fused(w1[0], w2[0]), mode="ar")
 
     y = jax.jit(
         jax.shard_map(

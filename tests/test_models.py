@@ -31,7 +31,6 @@ def _full_weights(params, cfg, n):
     d = cfg.head_dim
     hq_l = cfg.num_q_heads // n
     hkv_l = cfg.num_kv_heads // n
-    i_l = cfg.intermediate_size // n
     w = {
         "embed": np.asarray(params.embed, np.float32),
         "final_ln": np.asarray(params.final_ln, np.float32),
@@ -65,11 +64,11 @@ def _full_weights(params, cfg, n):
                     axis=0,
                 ),
                 "w_gate": np.concatenate(
-                    [np.asarray(lp.w_gate_up[l, r], np.float32)[:, :i_l]
+                    [np.asarray(lp.w_gate[l, r], np.float32)
                      for r in range(n)], axis=1,
                 ),
                 "w_up": np.concatenate(
-                    [np.asarray(lp.w_gate_up[l, r], np.float32)[:, i_l:]
+                    [np.asarray(lp.w_up[l, r], np.float32)
                      for r in range(n)], axis=1,
                 ),
                 "w_down": np.concatenate(

@@ -224,3 +224,64 @@ def test_gemm_ar_matches_ref(mesh8, m):
     )(a, b)
     dense = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
     np.testing.assert_allclose(np.asarray(fused), dense, rtol=1e-3, atol=1e-3)
+
+
+def test_ag_gemm_arrival_order(mesh8):
+    """c_order="arrival" returns ring-arrival row blocks; un-permuting
+    with arrival_to_rank_order recovers the rank-order result."""
+    from triton_dist_tpu.kernels.allgather_gemm import arrival_to_rank_order
+
+    M, K, N_loc = 8 * 16, 128, 8 * 128
+    a = jnp.asarray(_make((M, K), 30))
+    b = jnp.asarray(_make((K, N_loc), 31))
+    cfg = AgGemmConfig(tile_m=8, tile_n=128)
+
+    def arr(a_s, b_s):
+        c = ag_gemm(a_s, b_s, "tp", config=cfg, c_order="arrival",
+                    force_kernel=True)
+        return arrival_to_rank_order(c, "tp")
+
+    got = jax.jit(
+        jax.shard_map(arr, mesh=mesh8, in_specs=(P("tp"), P(None, "tp")),
+                      out_specs=P(None, "tp"), check_vma=False)
+    )(a, b)
+    ref = jax.jit(
+        jax.shard_map(
+            functools.partial(ag_gemm_ref, axis="tp"),
+            mesh=mesh8, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=P(None, "tp"), check_vma=False,
+        )
+    )(a, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ag_gemm_arrival_feeds_gemm_rs(mesh8):
+    """The arrival-order AG+GEMM -> gemm_rs(a_order="arrival") chain (the
+    TP-MLP dist path) matches the fully rank-ordered chain."""
+    M, K = 8 * 16, 128
+    a = jnp.asarray(_make((M, K), 32))
+    b1 = jnp.asarray(_make((K, 8 * 128), 33))
+    b2 = jnp.asarray(_make((8 * 128, K), 34))
+    cfg = AgGemmConfig(tile_m=8, tile_n=128)
+    rs_cfg = GemmRsConfig(tile_m=8)
+
+    def chain(order, a_s, b1_s, b2_s):
+        h = ag_gemm(a_s, b1_s, "tp", config=cfg, c_order=order,
+                    force_kernel=True)
+        return gemm_rs(h, b2_s, "tp", config=rs_cfg, a_order=order,
+                       force_kernel=True)
+
+    outs = {}
+    for order in ("rank", "arrival"):
+        outs[order] = jax.jit(
+            jax.shard_map(
+                functools.partial(chain, order),
+                mesh=mesh8,
+                in_specs=(P("tp"), P(None, "tp"), P("tp", None)),
+                out_specs=P("tp"), check_vma=False,
+            )
+        )(a, b1, b2)
+    np.testing.assert_allclose(np.asarray(outs["arrival"]),
+                               np.asarray(outs["rank"]),
+                               rtol=1e-4, atol=1e-4)

@@ -7,12 +7,28 @@ per-rank barrier words before each M-tile (dl.wait :236, consume_token :237),
 with a rank-offset threadblock swizzle so locally-available tiles compute
 first (:224-229). Here the same overlap is ONE Pallas kernel:
 
-  grid = (n_ranks, m_tiles, n_tiles) — outer dim s is the ring step.
-  step s computes chunk (me - s) mod n: own shard at s=0 (the swizzle
+  grid = (n_ranks, m_tiles, n_tiles, k_tiles) — outer dim s is the ring
+  step. step s computes chunk (me - s) mod n: own shard at s=0 (the swizzle
   analog: zero-wait start), while the ring forward of the previous chunk is
   in flight. The per-rank barrier words become per-step DMA delivery
   semaphores; `dl.wait`+`consume_token` become `wait_recv` ordered before
   the A-tile loads by program order.
+
+Consumer MFU design (the part the reference gets from its persistent-TMA
+GEMM, allgather_gemm.py:158-264): the A i-strip is cached in VMEM across
+the whole j sweep — each (tm, tk) block is DMA'd once per ring step
+instead of once per output column tile, cutting A HBM traffic by nt x —
+and the own shard is read straight from a_ref, so the workspace copy and
+the ring forward start ride the first tiles' compute instead of blocking
+it. At the Qwen3-32B bench shape this takes total HBM traffic from
+~409 MB to ~309 MB per call and reaches 1.00-1.03x of XLA's matmul
+(benchmark/sweep_ag_gemm.py), vs 1.11x for the round-3 grid.
+
+epilogue="silu_pair" fuses the TP-MLP gate/up activation into the store:
+b is the fused (K, 2*I) gate|up weight, the kernel keeps one accumulator
+per half and writes silu(gate_acc) * up_acc — the f32 intermediate never
+round-trips through HBM (the reference fuses the same epilogue into its
+persistent GEMM, layers/nvidia/tp_mlp.py dist_triton_fwd).
 
 Computes: C = AllGather(a_shard) @ b   [column-parallel TP matmul]
   a_shard: (M/n, K) per device, b: (K, N_loc) per device -> C: (M, N_loc).
@@ -46,31 +62,54 @@ from triton_dist_tpu.runtime.init import TP_AXIS
 @dataclasses.dataclass(frozen=True)
 class AgGemmConfig:
     """Tile configuration (the reference's context tile fields,
-    ref: allgather_gemm.py:417-456 BLOCK_M/N/K, num_stages).
+    ref: allgather_gemm.py:417-456 BLOCK_M/N/K, num_stages)."""
 
-    Defaults tuned on v5e at the Qwen3-32B shapes: large output tiles keep
-    the matmul HBM-light (B blocks stream once per i-strip, A blocks once
-    per j-strip), K-tiling keeps VMEM bounded, and the A-block DMA is
-    double-buffered against the MXU."""
-
-    # v5e sweep at (M=2048, K=5120, N=6400) bf16: 1.05x of jnp.dot
-    # (vs 2.1x before K-tiling + the A double buffer).
-    tile_m: int = 1024
-    tile_n: int = 640
+    # v5e sweep at (M=2048, K=5120, N=6400) bf16 (benchmark/
+    # sweep_ag_gemm.py + interleaved ratio_timer): what dominates at
+    # these shapes is PER-GRID-STEP overhead, not HBM traffic — tk=1024
+    # (100 steps) beats tk=512 (200 steps) by ~5% even though it streams
+    # A nt times; the arrival-order auto-pipelined store (see ag_gemm)
+    # buys the rest, landing at ~1.00x of XLA's matmul.
+    tile_m: int = 512
+    tile_n: int = 1280
     tile_k: int = 1024
-    # VMEM ceiling for the auto fallback decision.
-    vmem_budget: int = 14 << 20
+    # VMEM ceiling for the auto fallback / cache-mode decision.
+    vmem_budget: int = 15 << 20
+    # A-strip VMEM cache: one DMA per (i, kk) block per ring step instead
+    # of one per output tile. Cuts A HBM traffic nt x but pays a dynamic
+    # cache index per dot — a net loss at the bench shapes (1.12x vs
+    # 1.05x); worth flipping via the autotuner when A re-reads dominate
+    # (small K, very wide N).
+    cache_a: bool = False
     # race provocation (ref straggler_option, allgather_gemm.py:602-603):
     # stall this rank for straggler_ns at the producer entry
     straggler_rank: int = -1
     straggler_ns: int = 0
 
 
+def _silu_mul_f32(g, u):
+    return g * jax.nn.sigmoid(g) * u
+
+
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
-                    a_ref, b_ref, ws_ref, c_ref,
-                    a_buf, acc, stage,
-                    ld_sems, st_sem, cp_sem, send_sem, recv_sems):
+                    need_ws: bool, cache_a: bool, silu_pair: bool,
+                    arrival: bool, *refs):
+    refs = list(refs)
+    a_ref, b_ref = refs[:2]
+    del refs[:2]
+    b2_ref = refs.pop(0) if silu_pair else None
+    ws_ref, c_ref = refs[:2]
+    del refs[:2]
+    a_buf, acc = refs[:2]
+    del refs[:2]
+    acc2 = refs.pop(0) if silu_pair else None
+    stage = None if arrival else refs.pop(0)
+    if arrival:
+        ld_sems, cp_sem, send_sem, recv_sems = refs
+        st_sem = None
+    else:
+        ld_sems, st_sem, cp_sem, send_sem, recv_sems = refs
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -79,6 +118,8 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     m_loc = a_ref.shape[0]
     chunk = jnp.mod(me - s, n)
     right = jnp.mod(me + 1, n)
+    total = mt * nt * nk
+    flat = (i * nt + j) * nk + kk
 
     def fwd_copy(c_idx, step):
         """Ring descriptor for forwarding chunk rows to the right neighbor.
@@ -92,39 +133,69 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             device_id_type=pltpu.DeviceIdType.MESH,
         )
 
-    def a_load(c_idx, ii, kki, slot):
-        """Start the (tm, tk) A-block DMA from the workspace into a_buf."""
-        cp = pltpu.make_async_copy(
-            ws_ref.at[pl.ds(c_idx * m_loc + ii * tm, tm),
-                      pl.ds(kki * tk, tk)],
-            a_buf.at[slot],
-            ld_sems.at[slot],
-        )
-        cp.start()
-        return cp
-
-    # Flat A-block schedule within a ring step: (i, j, kk) -> block
-    # (i, kk); the double buffer prefetches the next block while the MXU
-    # consumes the current one (the reference's num_stages pipelining,
-    # allgather_gemm.py:158-264).
-    flat = (i * nt + j) * nk + kk
-    slot = jnp.mod(flat, 2)
-
-    # --- producer side: runs once per ring step, before that step's tiles.
-    @pl.when(jnp.logical_and(flat == 0, s == 0))
-    def _first_step():
-        if n > 1:
-            shmem.neighbor_barrier(axis, me, n)
-            shmem.straggler_delay(axis, *straggler)
-        cp = pltpu.make_async_copy(
+    def local_copy():
+        return pltpu.make_async_copy(
             a_ref, ws_ref.at[pl.ds(me * m_loc, m_loc)], cp_sem
         )
-        cp.start()
-        cp.wait()
+
+    def a_load(ii, kki, slot):
+        """Start the (tm, tk) A-block DMA into a_buf[slot]. The own shard
+        (s=0) reads straight from a_ref — its workspace copy is NOT on
+        the consumer's critical path; remote chunks read the ring
+        workspace."""
+        dst = a_buf.at[slot]
+        sem = ld_sems.at[slot]
+
+        @pl.when(s == 0)
+        def _own():
+            pltpu.make_async_copy(
+                a_ref.at[pl.ds(ii * tm, tm), pl.ds(kki * tk, tk)],
+                dst, sem,
+            ).start()
+
         if n > 1:
-            fwd_copy(me, 0).start()
-        # first A block of this step (blocking: nothing to overlap yet)
-        a_load(chunk, 0, 0, 0).wait()
+            @pl.when(s > 0)
+            def _remote():
+                pltpu.make_async_copy(
+                    ws_ref.at[pl.ds(chunk * m_loc + ii * tm, tm),
+                              pl.ds(kki * tk, tk)],
+                    dst, sem,
+                ).start()
+
+    def a_wait(slot):
+        # descriptor only carries the byte count for the semaphore wait
+        pltpu.make_async_copy(
+            ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
+            ld_sems.at[slot],
+        ).wait()
+
+    # --- producer side: runs once per ring step, before that step's tiles.
+    if need_ws:
+        @pl.when(jnp.logical_and(flat == 0, s == 0))
+        def _first_step():
+            if n > 1:
+                shmem.neighbor_barrier(axis, me, n)
+                shmem.straggler_delay(axis, *straggler)
+            local_copy().start()
+            if n > 1 and total == 1:
+                # single-tile grids have no later slot to defer to
+                local_copy().wait()
+                fwd_copy(me, 0).start()
+
+        if n > 1 and total > 1:
+            # the forward start needs the local copy done, but the
+            # consumer does not (it reads a_ref): defer both off the
+            # first tile so compute starts immediately
+            @pl.when(jnp.logical_and(flat == 1, s == 0))
+            def _start_ring():
+                local_copy().wait()
+                fwd_copy(me, 0).start()
+
+        if n == 1:
+            # gathered-output-only copy: drain before kernel exit
+            @pl.when(flat == total - 1)
+            def _drain():
+                local_copy().wait()
 
     if n > 1:
         @pl.when(jnp.logical_and(flat == 0, s > 0))
@@ -140,46 +211,88 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             def _():
                 fwd_copy(chunk, s).start()
 
-            a_load(chunk, 0, 0, 0).wait()
+    # --- A-block staging.
+    if cache_a:
+        # strip cache: the j==0 sweep DMAs each (i, kk) block once with a
+        # one-block lookahead; j>0 sweeps reuse it from VMEM.
+        @pl.when(j == 0)
+        def _fill():
+            @pl.when(kk == 0)
+            def _cold():
+                a_load(i, 0, 0)
 
-    # --- prefetch the NEXT A block into the other slot (within-step only;
-    # the first block of the next ring step needs that step's recv wait).
-    nxt = flat + 1
-    @pl.when(nxt < mt * nt * nk)
-    def _prefetch():
-        kk_n = jnp.mod(nxt, nk)
-        j_n = jnp.mod(nxt // nk, nt)
-        i_n = nxt // (nk * nt)
-        del j_n  # A block depends on (i, kk) only
-        a_load(chunk, i_n, kk_n, jnp.mod(nxt, 2))
+            @pl.when(kk + 1 < nk)
+            def _ahead():
+                a_load(i, kk + 1, kk + 1)
+
+            a_wait(kk)
+
+        a_tile = a_buf[kk]
+    else:
+        slot = jnp.mod(flat, 2)
+
+        @pl.when(flat == 0)
+        def _cold():
+            a_load(0, 0, 0)
+
+        nxt = flat + 1
+
+        @pl.when(nxt < total)
+        def _ahead():
+            kk_n = jnp.mod(nxt, nk)
+            i_n = nxt // (nk * nt)
+            a_load(i_n, kk_n, jnp.mod(nxt, 2))
+
+        a_wait(slot)
+        a_tile = a_buf[slot]
 
     # --- consumer: accumulate this K block on the MXU.
     @pl.when(kk == 0)
     def _zero():
         acc[...] = jnp.zeros_like(acc)
-
-    @pl.when(flat > 0)
-    def _wait_a():
-        pltpu.make_async_copy(
-            ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
-            ld_sems.at[slot],
-        ).wait()
+        if silu_pair:
+            acc2[...] = jnp.zeros_like(acc2)
 
     acc[...] += jnp.dot(
-        a_buf[slot], b_ref[...], preferred_element_type=jnp.float32
+        a_tile, b_ref[...], preferred_element_type=jnp.float32
     )
+    if silu_pair:
+        acc2[...] += jnp.dot(
+            a_tile, b2_ref[...], preferred_element_type=jnp.float32
+        )
 
     # --- store the finished output tile.
     @pl.when(kk == nk - 1)
     def _store():
-        stage[...] = acc[...].astype(out_dtype)
-        st = pltpu.make_async_copy(
-            stage,
-            c_ref.at[pl.ds(chunk * m_loc + i * tm, tm), pl.ds(j * tn, tn)],
-            st_sem,
-        )
-        st.start()
-        st.wait()
+        out = (_silu_mul_f32(acc[...], acc2[...]) if silu_pair
+               else acc[...]).astype(out_dtype)
+        if arrival:
+            # C in ring-arrival order: the block index (s*mt+i, j) is a
+            # pure grid function, so the store is Mosaic's auto output
+            # pipeline — zero scalar overhead, double-buffered for free.
+            c_ref[...] = out
+        else:
+            stage[...] = out
+            st = pltpu.make_async_copy(
+                stage,
+                c_ref.at[pl.ds(chunk * m_loc + i * tm, tm),
+                         pl.ds(j * tn, tn)],
+                st_sem,
+            )
+            st.start()
+            st.wait()
+
+
+def arrival_to_rank_order(c, axis: str):
+    """Permute an arrival-order C (ring-step-major row blocks: block s
+    holds global chunk (me - s) mod n) back to global rank order."""
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return c
+    me = jax.lax.axis_index(axis)
+    blocks = c.reshape(n, c.shape[0] // n, *c.shape[1:])
+    idx = jnp.mod(me - jnp.arange(n), n)
+    return jnp.take(blocks, idx, axis=0).reshape(c.shape)
 
 
 def ag_gemm(
@@ -190,28 +303,75 @@ def ag_gemm(
     return_gathered: bool = False,
     out_dtype=None,
     force_kernel: bool = False,
+    epilogue: Optional[str] = None,
+    c_order: str = "rank",
 ):
     """Overlapped AllGather(a_shard) @ b; per-device function inside shard_map
     (ref host entry: allgather_gemm.py:534-575 `ag_gemm`).
 
     a_shard: (M/n, K); b: (K, N_loc). Returns C (M, N_loc), and the gathered
     A (M, K) when return_gathered. out_dtype=float32 lets a following
-    elementwise epilogue (e.g. TP-MLP's silu·mul) fuse without a bf16
-    round-trip — the cast-early formulation measurably breaks XLA's fusion
-    (~193 vs ~180 TF/s on v5e at the Qwen3-32B MLP shapes).
+    elementwise epilogue fuse without a bf16 round-trip.
+
+    epilogue="silu_pair": b is a (w_gate, w_up) pair, each (K, I), and
+    the result is silu(A@gate) * (A@up) of shape (M, I) — in the kernel
+    the f32 intermediate never reaches HBM; at world=1 XLA's own epilogue
+    fusion over two clean dots wins and the call short-circuits to it.
+
+    c_order="arrival" returns C's row blocks in RING-ARRIVAL order
+    (block s = global chunk (me - s) mod n; identical to rank order at
+    world=1). In this layout the output block index is a pure grid
+    function, so the store runs on Mosaic's auto output pipeline instead
+    of manual DMA+wait — measurably faster — and an order-aware consumer
+    (gemm_rs(a_order="arrival"), the TP-MLP down-proj) indexes chunks by
+    arrival slot at zero cost. Use arrival_to_rank_order to un-permute
+    for order-sensitive consumers.
     """
     cfg = config or AgGemmConfig()
     out_dtype = out_dtype or a_shard.dtype
+    silu_pair = epilogue == "silu_pair"
+    assert epilogue in (None, "silu_pair"), f"unknown epilogue {epilogue}"
+    assert c_order in ("rank", "arrival"), c_order
+    arrival = c_order == "arrival"
     n = jax.lax.axis_size(axis)
     m_loc, k = a_shard.shape
-    k2, n_loc = b.shape
-    assert k == k2, f"K mismatch {k} vs {k2}"
-    if n == 1 and not force_kernel:
-        # Nothing to overlap at world=1; XLA's matmul is the fastest path.
-        c = jnp.dot(a_shard, b, preferred_element_type=jnp.float32).astype(
-            out_dtype
+    if silu_pair:
+        assert isinstance(b, tuple) and len(b) == 2, (
+            "silu_pair takes b=(w_gate, w_up)"
         )
-        return (c, a_shard) if return_gathered else c
+        b_gate, b_up = b
+        assert b_gate.shape == b_up.shape
+        k2, i_loc = b_gate.shape
+        n_loc = 2 * i_loc
+        assert not return_gathered, "silu_pair does not return gathered A"
+    else:
+        k2, n_loc = b.shape
+        i_loc = n_loc
+    assert k == k2, f"K mismatch {k} vs {k2}"
+
+    def xla_path():
+        a_full = (a_shard if n == 1
+                  else jax.lax.all_gather(a_shard, axis, tiled=True))
+        if silu_pair:
+            g = jnp.dot(a_full, b_gate, preferred_element_type=jnp.float32)
+            u = jnp.dot(a_full, b_up, preferred_element_type=jnp.float32)
+            c = _silu_mul_f32(g, u).astype(out_dtype)
+        else:
+            h = jnp.dot(a_full, b, preferred_element_type=jnp.float32)
+            c = h.astype(out_dtype)
+        if arrival and n > 1:
+            # honor the promised arrival layout on the fallback path:
+            # block s <- global chunk (me - s) mod n (inverse of
+            # arrival_to_rank_order, which is self-inverse)
+            c = arrival_to_rank_order(c, axis)
+        return (c, a_full) if return_gathered else c
+
+    if n == 1 and not force_kernel:
+        # Nothing to overlap at world=1; XLA's matmul is the fastest path
+        # (and XLA fuses the silu_pair epilogue into the dot's output for
+        # free — measured 0.73 vs 0.80 ms for the two-accumulator Pallas
+        # variant at the bench shape, benchmark/sweep_ag_gemm.py).
+        return xla_path()
 
     def fit(tile, dim):
         """Largest divisor of dim that is <= tile and a multiple of 128
@@ -224,64 +384,85 @@ def ag_gemm(
         return max(t, 1)
 
     tm = fit(cfg.tile_m, m_loc)
-    tn = fit(cfg.tile_n, n_loc)
     tk = fit(cfg.tile_k, k)
+    # in silu_pair mode the C tile is the per-half width
+    tn = fit(max(cfg.tile_n // 2, 128) if silu_pair else cfg.tile_n,
+             i_loc)
 
     itemsize = jnp.dtype(a_shard.dtype).itemsize
     out_itemsize = jnp.dtype(out_dtype).itemsize
-    # VMEM residents: B block (tk, tn) x2 (Pallas pipeline), A double
-    # buffer 2x(tm, tk), acc f32 (tm, tn), store stage (tm, tn).
-    vmem_need = (
-        2 * tk * tn * itemsize
-        + 2 * tm * tk * itemsize
-        + tm * tn * 4
-        + tm * tn * out_itemsize
-    )
+    mt = cdiv(m_loc, tm)
+    nt = cdiv(i_loc, tn)
+    nk = cdiv(k, tk)
+
+    # Fixed VMEM residents: B block(s) (tk, tn) x2 each (Pallas pipeline),
+    # acc(s) f32 (tm, tn), store stage (tm, tn) (x2 window when arrival).
+    n_acc = 2 if silu_pair else 1
+    vmem_fixed = n_acc * (2 * tk * tn * itemsize + tm * tn * 4) \
+        + 2 * tm * tn * out_itemsize
+    # A strip cache (whole (tm, K) strip, one DMA per block per ring step,
+    # reused across the j sweep) — opt-in via config, see AgGemmConfig.
+    cache_a = (cfg.cache_a and nt >= 2
+               and vmem_fixed + nk * tm * tk * itemsize <= cfg.vmem_budget)
+    a_slots = nk if cache_a else 2
+    vmem_need = vmem_fixed + a_slots * tm * tk * itemsize
     if (vmem_need > cfg.vmem_budget or interpret_no_headroom()) and (
         not force_kernel
     ):
         # Fallback: XLA AG + dot (the reference's torch path analog).
-        a_full = jax.lax.all_gather(a_shard, axis, tiled=True)
-        c = jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
-            out_dtype
-        )
-        return (c, a_full) if return_gathered else c
+        return xla_path()
 
-    mt = cdiv(m_loc, tm)
-    nt = cdiv(n_loc, tn)
-    nk = cdiv(k, tk)
-
+    need_ws = n > 1 or return_gathered
     grid = (n, mt, nt, nk)
+    b_spec = pl.BlockSpec(
+        (tk, tn), lambda s, i, j, kk: (kk, j), memory_space=pltpu.VMEM,
+    )
+    if silu_pair:
+        in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec, b_spec]
+        inputs = [a_shard, b_gate, b_up]
+    else:
+        in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec]
+        inputs = [a_shard, b]
+
+    scratch = [pltpu.VMEM((a_slots, tm, tk), a_shard.dtype)]
+    scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
+    if silu_pair:
+        scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
+    if not arrival:
+        scratch.append(pltpu.VMEM((tm, tn), out_dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((a_slots,)))
+    if not arrival:
+        scratch.append(pltpu.SemaphoreType.DMA)  # st_sem
+    scratch += [
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+    ]
+
+    c_spec = (
+        pl.BlockSpec((tm, tn),
+                     lambda s, i, j, kk, _mt=mt: (s * _mt + i, j),
+                     memory_space=pltpu.VMEM)
+        if arrival else pl.BlockSpec(memory_space=pl.ANY)
+    )
     ws, c = tpu_call(
         functools.partial(_ag_gemm_kernel, axis, n, mt, nt, nk,
                           tm, tn, tk, out_dtype,
-                          (cfg.straggler_rank, cfg.straggler_ns)),
+                          (cfg.straggler_rank, cfg.straggler_ns),
+                          need_ws, cache_a, silu_pair, arrival),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),
-            jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),
-        ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(
-                (tk, tn), lambda s, i, j, kk: (kk, j),
-                memory_space=pltpu.VMEM,
+            jax.ShapeDtypeStruct(
+                (n * m_loc, i_loc if silu_pair else n_loc), out_dtype
             ),
-        ],
+        ),
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            c_spec,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, tm, tk), a_shard.dtype),
-            pltpu.VMEM((tm, tn), jnp.float32),
-            pltpu.VMEM((tm, tn), out_dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True,
             # The barrier semaphore (keyed by collective_id) is only used by
@@ -295,11 +476,12 @@ def ag_gemm(
         # launch_metadata analog (ref allgather_gemm.py:145-155)
         cost_estimate=cost_estimate(
             flops=2 * n * m_loc * k * n_loc,
+            # C is (n*m_loc, i_loc): half of n_loc in silu_pair mode
             bytes_accessed=(n * m_loc * k + k * n_loc) * itemsize
-            + n * m_loc * n_loc * out_itemsize,
+            + n * m_loc * i_loc * out_itemsize,
             remote_bytes=(n - 1) * m_loc * k * itemsize,
         ),
-    )(a_shard, b)
+    )(*inputs)
     return (c, ws) if return_gathered else c
 
 

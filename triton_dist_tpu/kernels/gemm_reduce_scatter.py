@@ -51,31 +51,47 @@ class GemmRsConfig:
     straggler_ns: int = 0
 
 
-def _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, dst, ld_sem,
+def _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, dst, ld_sems,
                    out_dtype):
-    """dst[:] = a[chunk rows] @ b, tiled over M (b resident in VMEM)."""
+    """dst[:] = a[chunk rows] @ b, tiled over M (b resident in VMEM).
+    A-tile loads are double-buffered against the MXU so no load is
+    exposed past the first (the consumer-side pipelining the reference
+    gets from num_stages, gemm_reduce_scatter.py:122-248)."""
     mt = m_loc // tm
-    for i in range(mt):
-        cp = pltpu.make_async_copy(
-            a_ref.at[pl.ds(chunk * m_loc + i * tm, tm)], a_tile, ld_sem
+
+    def load(i, slot):
+        return pltpu.make_async_copy(
+            a_ref.at[pl.ds(chunk * m_loc + i * tm, tm)], a_tile.at[slot],
+            ld_sems.at[slot],
         )
-        cp.start()
-        cp.wait()
+
+    load(0, 0).start()
+    for i in range(mt):
+        if i + 1 < mt:
+            load(i + 1, (i + 1) % 2).start()
+        load(i, i % 2).wait()
         dst[pl.ds(i * tm, tm), :] = jnp.dot(
-            a_tile[...], b_ref[...], preferred_element_type=jnp.float32
+            a_tile[i % 2], b_ref[...], preferred_element_type=jnp.float32
         ).astype(out_dtype)
 
 
 def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
+                    a_arrival: bool,
                     a_ref, b_ref, o_ref, acc, stage, a_tile,
-                    ld_sem, st_sem, send_sem, recv_sems, credit_sem):
+                    ld_sems, st_sem, send_sem, recv_sems, credit_sem):
     me = jax.lax.axis_index(axis)
     m_loc = o_ref.shape[0]
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
 
+    def src_slot(chunk):
+        # a_arrival: A's row blocks are in ag_gemm ring-arrival order
+        # (block s = chunk (me - s) mod n), so global chunk c lives at
+        # slot (me - c) mod n — a zero-cost index remap.
+        return jnp.mod(me - chunk, n) if a_arrival else chunk
+
     if n == 1:
-        _partial_chunk(a_ref, b_ref, 0, m_loc, tm, a_tile, acc.at[0], ld_sem,
+        _partial_chunk(a_ref, b_ref, 0, m_loc, tm, a_tile, acc.at[0], ld_sems,
                        out_dtype)
         st = pltpu.make_async_copy(acc.at[0], o_ref, st_sem)
         st.start()
@@ -93,8 +109,8 @@ def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
 
     # Compute our partial of the first travelling chunk, (me-1) mod n.
     first = jnp.mod(me - 1, n)
-    _partial_chunk(a_ref, b_ref, first, m_loc, tm, a_tile, acc.at[0], ld_sem,
-                   out_dtype)
+    _partial_chunk(a_ref, b_ref, src_slot(first), m_loc, tm, a_tile,
+                   acc.at[0], ld_sems, out_dtype)
 
     for s in range(n - 1):
         cur, nxt = s % 2, (s + 1) % 2
@@ -111,8 +127,8 @@ def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
         # MXU fills the stage with our partial of the incoming chunk while
         # the hop is in flight — this is the producer/consumer overlap.
         chunk = jnp.mod(me - s - 2, n)
-        _partial_chunk(a_ref, b_ref, chunk, m_loc, tm, a_tile, stage, ld_sem,
-                       out_dtype)
+        _partial_chunk(a_ref, b_ref, src_slot(chunk), m_loc, tm, a_tile,
+                       stage, ld_sems, out_dtype)
         rdma.wait_send()
         if s + 1 <= n - 2:
             pltpu.semaphore_signal(
@@ -135,15 +151,21 @@ def gemm_rs(
     config: Optional[GemmRsConfig] = None,
     out_dtype=None,
     force_kernel: bool = False,
+    a_order: str = "rank",
 ) -> jax.Array:
     """Overlapped ReduceScatter(a @ b); per-device function inside shard_map
     (ref host entry: gemm_reduce_scatter.py:569-583 `gemm_rs`).
 
     a: (M, K_loc); b: (K_loc, N). Returns rank's reduced chunk (M/n, N).
     out_dtype also sets the cross-rank accumulation dtype in the ring.
+    a_order="arrival" consumes A whose row blocks are in ag_gemm's
+    ring-arrival order (see ag_gemm c_order) by remapping the chunk
+    index — free in the kernel, a block un-permute on fallback paths.
     """
     cfg = config or GemmRsConfig()
     out_dtype = out_dtype or a.dtype
+    assert a_order in ("rank", "arrival"), a_order
+    a_arrival = a_order == "arrival"
     n = jax.lax.axis_size(axis)
     m, k_loc = a.shape
     k2, n_full = b.shape
@@ -166,11 +188,17 @@ def gemm_rs(
     vmem_need = (
         k_loc * n_full * in_itemsize
         + 3 * m_loc * n_full * out_itemsize
-        + tm * k_loc * in_itemsize
+        + 2 * tm * k_loc * in_itemsize
     )
     if (vmem_need > cfg.vmem_budget or interpret_no_headroom()) and (
         not force_kernel
     ):
+        if a_arrival and n > 1:
+            from triton_dist_tpu.kernels.allgather_gemm import (
+                arrival_to_rank_order,
+            )
+
+            a = arrival_to_rank_order(a, axis)
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
@@ -178,7 +206,8 @@ def gemm_rs(
 
     return tpu_call(
         functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype,
-                          (cfg.straggler_rank, cfg.straggler_ns)),
+                          (cfg.straggler_rank, cfg.straggler_ns),
+                          a_arrival),
         out_shape=jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -188,8 +217,8 @@ def gemm_rs(
         scratch_shapes=[
             pltpu.VMEM((2, m_loc, n_full), out_dtype),
             pltpu.VMEM((m_loc, n_full), out_dtype),
-            pltpu.VMEM((tm, k_loc), a.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, tm, k_loc), a.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA((2,)),

@@ -7,16 +7,17 @@ ag_gemm/gemm_rs, AR modes :180-276 via gemm+allreduce); here the same
 three modes are per-device functions meant for use inside `jax.shard_map`:
 
   xla_fwd  — unfused XLA collectives (the torch_fwd parity reference)
-  dist_fwd — fused ag_gemm -> silu*up -> gemm_rs (sequence-sharded M)
+  dist_fwd — fused ag_gemm(silu_pair) -> gemm_rs (sequence-sharded M)
   ar_fwd   — replicated input, local gemm + gemm_ar (decode/low-latency)
 
-Weight layout per rank: w_gate_up (hidden, 2*I/n) with gate in the first
-half of the columns, w_down (I/n, hidden).
-
-Perf note: dist_fwd keeps the gate/up activations in f32 between the two
-matmuls (out_dtype=f32 on ag_gemm, single cast after silu*up). Measured on
-v5e at the Qwen3-32B MLP shapes this is ~193 TF/s vs ~180 TF/s for the
-cast-early formulation — the bf16 round-trip breaks XLA's epilogue fusion.
+Weight layout per rank: w_gate (hidden, I/n), w_up (hidden, I/n),
+w_down (I/n, hidden). Gate and up are stored as SEPARATE arrays (like the
+HF checkpoints the reference streams, models/dense.py:150-167): measured
+on v5e at the Qwen3-32B MLP shapes, XLA fuses silu(g)*u into the output
+of two clean dots (1.047 ms e2e) but cannot fuse it across a slice of a
+fused (hidden, 2I) dot output (1.18 ms) — the split layout is worth
+~0.13 ms per MLP. `from_fused` converts the packed layout the models
+store (the megakernel wants it fused for one-DMA weight streaming).
 """
 
 from __future__ import annotations
@@ -37,24 +38,35 @@ from triton_dist_tpu.runtime.init import TP_AXIS
 
 
 class TPMLPParams(NamedTuple):
-    """Per-rank shards: w_gate_up (hidden, 2*I/n), w_down (I/n, hidden)."""
+    """Per-rank shards: w_gate/w_up (hidden, I/n), w_down (I/n, hidden)."""
 
-    w_gate_up: jax.Array
+    w_gate: jax.Array
+    w_up: jax.Array
     w_down: jax.Array
 
+    @classmethod
+    def from_fused(cls, w_gate_up: jax.Array, w_down: jax.Array):
+        """Split a packed (hidden, 2*I/n) gate|up weight (the models'
+        storage layout) into the layer's split layout."""
+        i_loc = w_gate_up.shape[-1] // 2
+        return cls(w_gate_up[:, :i_loc], w_gate_up[:, i_loc:], w_down)
 
-def _silu_mul(h):
-    """silu(gate) * up on a fused (.., 2*I) activation, f32 math."""
-    gate, up = jnp.split(h.astype(jnp.float32), 2, axis=-1)
-    return jax.nn.silu(gate) * up
+
+def _silu_mul(g, u):
+    """silu(gate) * up in f32 math — the SAME formula the fused kernel
+    epilogue uses (single definition; parity tests compare the paths)."""
+    from triton_dist_tpu.kernels.allgather_gemm import _silu_mul_f32
+
+    return _silu_mul_f32(g.astype(jnp.float32), u.astype(jnp.float32))
 
 
 def tp_mlp_xla_fwd(x_shard, params: TPMLPParams, axis: str = TP_AXIS):
-    """Unfused parity path (ref torch_fwd, tp_mlp.py:107): AG + dot +
+    """Unfused parity path (ref torch_fwd, tp_mlp.py:107): AG + dots +
     psum_scatter. x_shard: (M/n, hidden) -> (M/n, hidden)."""
     x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
-    h = jnp.dot(x_full, params.w_gate_up, preferred_element_type=jnp.float32)
-    act = _silu_mul(h).astype(x_shard.dtype)
+    g = jnp.dot(x_full, params.w_gate, preferred_element_type=jnp.float32)
+    u = jnp.dot(x_full, params.w_up, preferred_element_type=jnp.float32)
+    act = _silu_mul(g, u).astype(x_shard.dtype)
     partial = jnp.dot(act, params.w_down, preferred_element_type=jnp.float32)
     return jax.lax.psum_scatter(
         partial.astype(x_shard.dtype), axis, tiled=True
@@ -69,13 +81,17 @@ def tp_mlp_dist_fwd(
     rs_config: Optional[GemmRsConfig] = None,
 ):
     """Fused path (ref dist_triton_fwd, tp_mlp.py:147): overlapped
-    AG+GEMM then GEMM+RS. x_shard: (M/n, hidden) -> (M/n, hidden)."""
-    h = ag_gemm(
-        x_shard, params.w_gate_up, axis=axis, config=ag_config,
-        out_dtype=jnp.float32,
+    AG+GEMM with the silu(gate)*up epilogue fused into the kernel store
+    (the f32 intermediate never reaches HBM), then GEMM+RS.
+    x_shard: (M/n, hidden) -> (M/n, hidden)."""
+    act = ag_gemm(
+        x_shard, (params.w_gate, params.w_up), axis=axis, config=ag_config,
+        epilogue="silu_pair", c_order="arrival",
     )
-    act = _silu_mul(h).astype(x_shard.dtype)
-    return gemm_rs(act, params.w_down, axis=axis, config=rs_config)
+    # arrival-order act: gemm_rs remaps chunk indices for free (the
+    # row-block permutation never materializes)
+    return gemm_rs(act, params.w_down, axis=axis, config=rs_config,
+                   a_order="arrival")
 
 
 def tp_mlp_ar_fwd(
@@ -85,10 +101,11 @@ def tp_mlp_ar_fwd(
     rs_config: Optional[GemmRsConfig] = None,
 ):
     """Replicated-activation path (ref dist_triton_AR/gemm_ar fwd,
-    tp_mlp.py:180-276): local gate/up gemm + fused gemm+allreduce down.
+    tp_mlp.py:180-276): local gate/up gemms + fused gemm+allreduce down.
     x_full: (M, hidden) replicated -> (M, hidden) replicated."""
-    h = jnp.dot(x_full, params.w_gate_up, preferred_element_type=jnp.float32)
-    act = _silu_mul(h).astype(x_full.dtype)
+    g = jnp.dot(x_full, params.w_gate, preferred_element_type=jnp.float32)
+    u = jnp.dot(x_full, params.w_up, preferred_element_type=jnp.float32)
+    act = _silu_mul(g, u).astype(x_full.dtype)
     return gemm_ar(act, params.w_down, axis=axis, config=rs_config)
 
 

@@ -169,6 +169,19 @@ class MegaQwen3:
         )
         dt = jnp.dtype(cfg.dtype)
         self.dtype = dt
+        # fuse gate|up ONCE at init for one-DMA weight streaming in the
+        # kernel (params store them split so XLA can fuse the silu
+        # epilogue in the eager paths; see models/dense.py), then strip
+        # the split copies from the pytree this model's jit consumes —
+        # the kernel never reads them, and for a standalone MegaQwen3
+        # (no Engine sharing the params) stripping frees their HBM.
+        self._w_gate_up = jax.jit(
+            lambda g, u: jnp.concatenate([g, u], axis=-1),
+            out_shardings=NamedSharding(mesh, P(None, axis)),
+        )(self.params.layers.w_gate, self.params.layers.w_up)
+        self.params = self.params._replace(
+            layers=self.params.layers._replace(w_gate=None, w_up=None)
+        )
 
         mb, meta = build_qwen3_graph(cfg, batch, n, self.s_max, axis)
         self.graph = mb.graph
@@ -199,20 +212,24 @@ class MegaQwen3:
                                   for b in meta["vn_bufs"]])
 
         p_specs = param_specs(axis, moe=False)
+        p_specs = p_specs._replace(
+            layers=p_specs.layers._replace(w_gate=None, w_up=None)
+        )
         c_specs = MegaKVCache(k=P(None, axis), v=P(None, axis),
                               length=P())
 
-        def step(params: DenseLLMParams, tokens, cache: MegaKVCache):
-            return self._device_step(params, tokens, cache)
+        def step(params: DenseLLMParams, w_gate_up, tokens,
+                 cache: MegaKVCache):
+            return self._device_step(params, w_gate_up, tokens, cache)
 
         self._decode = jax.jit(
             jax.shard_map(
                 step, mesh=mesh,
-                in_specs=(p_specs, P(), c_specs),
+                in_specs=(p_specs, P(None, axis), P(), c_specs),
                 out_specs=(P(), c_specs),
                 check_vma=False,
             ),
-            donate_argnums=(2,) if donate_cache else (),
+            donate_argnums=(3,) if donate_cache else (),
         )
 
     # -- per-device step (inside shard_map) ---------------------------------
@@ -238,7 +255,8 @@ class MegaQwen3:
         ], axis=0)
         return jnp.repeat(norms, 8, axis=0)
 
-    def _device_step(self, params: DenseLLMParams, tokens, cache):
+    def _device_step(self, params: DenseLLMParams, w_gate_up, tokens,
+                     cache):
         cfg = self.cfg
         L = cfg.num_layers
         H = cfg.hidden_size
@@ -251,7 +269,7 @@ class MegaQwen3:
         weights = {
             "w_qkv": lp.w_qkv[:, 0],
             "w_o": lp.w_o[:, 0],
-            "w_gate_up": lp.w_gate_up[:, 0],
+            "w_gate_up": w_gate_up[:, 0],
             "w_down": lp.w_down[:, 0],
         }
 
@@ -304,5 +322,6 @@ class MegaQwen3:
     def decode_step(self, tokens, cache: MegaKVCache):
         """tokens (B,) -> (logits (B, V) f32, cache)."""
         return self._decode(
-            self.params, jnp.asarray(tokens, jnp.int32), cache
+            self.params, self._w_gate_up, jnp.asarray(tokens, jnp.int32),
+            cache
         )

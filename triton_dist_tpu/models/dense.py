@@ -15,7 +15,7 @@ reference's torch / triton_dist / triton_dist_AR:
 Sharding layout per tensor (n = tp size):
   embed (V, H) replicated · norms (L, H) replicated
   w_qkv (L, n, H, (Hq+2Hkv)/n*D) · w_o (L, n, Hq/n*D, H)
-  w_gate_up (L, n, H, 2I/n) · w_down (L, n, I/n, H)
+  w_gate / w_up (L, n, H, I/n) · w_down (L, n, I/n, H)
   lm_head (n, H, V/n)
 """
 
@@ -49,10 +49,16 @@ class DenseLayerParams(NamedTuple):
     w_o: jax.Array
     q_norm: jax.Array
     k_norm: jax.Array
-    # dense: w_gate_up (L, n, H, 2I/n), w_down (L, n, I/n, H)
+    # dense: w_gate/w_up (L, n, H, I/n) SEPARATE (like the HF checkpoint's
+    #   gate_proj/up_proj; the split layout is what lets XLA fuse the silu
+    #   epilogue — see layers/tp_mlp.py), w_down (L, n, I/n, H).
+    #   The megakernel fuses them once at init for one-DMA streaming.
     # MoE:   w_gate_up (L, n, E, H, 2I_moe/n), w_down (L, n, E, I_moe/n, H)
-    w_gate_up: jax.Array
+    #   stays fused (the grouped-GEMM expert layout).
     w_down: jax.Array
+    w_gate: Optional[jax.Array] = None
+    w_up: Optional[jax.Array] = None
+    w_gate_up: Optional[jax.Array] = None  # MoE only
     w_router: Optional[jax.Array] = None  # MoE only: (L, H, E) replicated
 
 
@@ -69,7 +75,10 @@ def param_specs(axis: str = TP_AXIS, moe: bool = False):
         input_ln=P(), post_attn_ln=P(),
         w_qkv=P(None, axis), w_o=P(None, axis),
         q_norm=P(), k_norm=P(),
-        w_gate_up=P(None, axis), w_down=P(None, axis),
+        w_down=P(None, axis),
+        w_gate=None if moe else P(None, axis),
+        w_up=None if moe else P(None, axis),
+        w_gate_up=P(None, axis) if moe else None,
         w_router=P() if moe else None,
     )
     return DenseLLMParams(
@@ -136,7 +145,8 @@ def init_params(
         )
     else:
         ffn = dict(
-            w_gate_up=mk((L, n, h, 2 * i_l)),
+            w_gate=mk((L, n, h, i_l)),
+            w_up=mk((L, n, h, i_l)),
             w_down=mk((L, n, i_l, h)),
             w_router=None,
         )
@@ -184,8 +194,10 @@ def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
             cfg.num_experts_per_tok, axis=axis, mode=mode,
         )
     else:
-        mlp_out = tp_mlp_fwd(h, TPMLPParams(lp.w_gate_up, lp.w_down),
-                             axis=axis, mode=mode)
+        mlp_out = tp_mlp_fwd(
+            h, TPMLPParams(lp.w_gate, lp.w_up, lp.w_down),
+            axis=axis, mode=mode,
+        )
     x = x + mlp_out
     return x, kv
 

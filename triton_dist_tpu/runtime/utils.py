@@ -90,6 +90,42 @@ def chain_timer(build_fn, args, k_lo=1, k_hi=101, pairs=9, warmup=2):
     }
 
 
+def ratio_timer(build_a, build_b, args, k_lo=1, k_hi=51, pairs=7,
+                warmup=2):
+    """Median per-round ratio of two chain-timed kernels.
+
+    The chip's clock drifts on a seconds timescale (shared pool /
+    DVFS): two chain_timer calls made back to back can disagree by
+    ±8%, which swamps a few-percent kernel comparison. Here each round
+    measures BOTH chains within milliseconds of each other, so the
+    drift cancels in the per-round ratio; the cross-round median then
+    rejects stragglers. Returns (ratio, a_ms, b_ms)."""
+    fa_lo, fa_hi = build_a(k_lo), build_a(k_hi)
+    fb_lo, fb_hi = build_b(k_lo), build_b(k_hi)
+    for f in (fa_lo, fa_hi, fb_lo, fb_hi):
+        np.asarray(f(*args))  # compile
+
+    def once(f):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))  # host fetch forces completion
+        return (time.perf_counter() - t0) * 1e3
+
+    for _ in range(warmup):
+        once(fa_hi), once(fb_hi)
+    ratios, da_all, db_all = [], [], []
+    for _ in range(pairs):
+        da = (once(fa_hi) - once(fa_lo)) / (k_hi - k_lo)
+        db = (once(fb_hi) - once(fb_lo)) / (k_hi - k_lo)
+        if da > 0 and db > 0:  # drop glitched rounds, never clamp
+            ratios.append(da / db)
+            da_all.append(da)
+            db_all.append(db)
+    if not ratios:
+        raise RuntimeError("ratio measurement failed: no positive rounds")
+    return (float(np.median(ratios)), float(np.median(da_all)),
+            float(np.median(db_all)))
+
+
 def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose=True):
     """allclose with mismatch dump (ref: utils.py:870-899)."""
     x = np.asarray(x)
