@@ -17,6 +17,11 @@ from triton_dist_tpu.models.dense import (  # noqa: F401
     cache_specs,
 )
 from triton_dist_tpu.models.engine import Engine, sample_token  # noqa: F401
+from triton_dist_tpu.models.load_hf import (  # noqa: F401
+    AutoLLM,
+    config_from_hf,
+    load_hf,
+)
 from triton_dist_tpu.models.qwen_moe import (  # noqa: F401
     auto_engine,
     qwen3_moe_engine,

@@ -1,0 +1,282 @@
+"""HF checkpoint loading: safetensors -> sharded DenseLLMParams.
+
+TPU-native re-design of the reference's weight init path
+(ref: python/triton_dist/models/dense.py:150-167 init_parameters — loads
+the HF torch model on CPU and per-layer TP-shards it onto the GPU — and
+models/__init__.py:33 AutoLLM, the name->model dispatch). Here there is
+no torch module tree to walk: tensors stream straight out of the
+checkpoint's safetensors files (mmap'd, one tensor at a time, via
+`safetensors.safe_open`), are TP-sharded on the host exactly as the
+reference's TP layers do (column-parallel q/k/v/gate/up, row-parallel
+o/down, vocab-parallel lm_head), and land on the mesh with one
+device_put per field.
+
+Layout notes (HF torch Linear stores (out_features, in_features); our
+kernels consume (in, out), so every projection transposes):
+  model.embed_tokens.weight (V, H)     -> embed (V, H)
+  model.norm.weight (H,)               -> final_ln
+  lm_head.weight (V, H)                -> lm_head (n, H, V/n)   [col-TP]
+  layers.i.self_attn.{q,k,v}_proj      -> w_qkv (L, n, H, (hq+2hkv)/n*D)
+  layers.i.self_attn.o_proj            -> w_o (L, n, hq/n*D, H) [row-TP]
+  layers.i.self_attn.{q,k}_norm (D,)   -> q_norm/k_norm (L, D)
+  layers.i.mlp.{gate,up}_proj          -> w_gate/w_up (L, n, H, I/n)
+  layers.i.mlp.down_proj               -> w_down (L, n, I/n, H) [row-TP]
+MoE (ref models/qwen_moe.py):
+  layers.i.mlp.gate.weight (E, H)      -> w_router (L, H, E)
+  layers.i.mlp.experts.e.{gate,up,down}_proj
+                                       -> w_gate_up (L, n, E, H, 2mi/n),
+                                          w_down (L, n, E, mi/n, H)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import (
+    DenseLayerParams,
+    DenseLLMParams,
+    param_specs,
+)
+from triton_dist_tpu.runtime.init import TP_AXIS
+
+
+def config_from_hf(path: str) -> ModelConfig:
+    """Build a ModelConfig from a checkpoint directory's config.json
+    (the reference reads the same fields through transformers'
+    AutoConfig inside init_model_cpu, models/utils.py)."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or [""])[0]
+    moe = "Moe" in arch or "num_experts" in hf
+    head_dim = hf.get("head_dim") or (
+        hf["hidden_size"] // hf["num_attention_heads"]
+    )
+    kw = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_q_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads",
+                            hf["num_attention_heads"]),
+        head_dim=head_dim,
+        rope_theta=float(hf.get("rope_theta", 1e6)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        max_positions=hf.get("max_position_embeddings", 4096),
+        dtype=str(hf.get("torch_dtype", "bfloat16")),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        # Qwen3 applies per-head rmsnorm to q/k; presence of the weights
+        # decides at load time, config decides here
+        use_qk_norm="Qwen3" in arch or hf.get("use_qk_norm", False),
+    )
+    if moe:
+        kw.update(
+            num_experts=hf.get("num_experts", hf.get("n_routed_experts", 0)),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 8),
+            moe_intermediate_size=hf.get("moe_intermediate_size", 0),
+        )
+    return ModelConfig(**kw)
+
+
+class _Checkpoint:
+    """name -> tensor access over one or many safetensors files (mmap'd,
+    one tensor materialized at a time, in the checkpoint's own dtype —
+    the analog of the reference's layer-by-layer streaming + gc,
+    dense.py:160-165)."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        self.path = path
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                self._file_of = json.load(f)["weight_map"]
+        else:
+            single = os.path.join(path, "model.safetensors")
+            if not os.path.exists(single):
+                raise FileNotFoundError(
+                    f"no model.safetensors[.index.json] under {path}"
+                )
+            self._file_of = None
+            self._single = single
+        self._open = {}
+        self._safe_open = safe_open
+
+    def _handle(self, name: str):
+        fname = (self._single if self._file_of is None
+                 else os.path.join(self.path, self._file_of[name]))
+        if fname not in self._open:
+            # framework="flax" yields jax arrays with native bf16 support
+            # (numpy has no bfloat16)
+            self._open[fname] = self._safe_open(fname, framework="flax")
+        return self._open[fname]
+
+    def names(self):
+        if self._file_of is not None:
+            return set(self._file_of)
+        return set(self._handle("").keys())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.names()
+
+    def get(self, name: str) -> np.ndarray:
+        # keep the checkpoint dtype (bf16 works on the host via
+        # ml_dtypes): peak host memory stays ~1x checkpoint size
+        return np.asarray(self._handle(name).get_tensor(name))
+
+
+def load_hf(
+    path: str,
+    mesh,
+    cfg: Optional[ModelConfig] = None,
+    axis: str = TP_AXIS,
+    dtype=None,
+) -> DenseLLMParams:
+    """Load an HF-format checkpoint directory into sharded
+    DenseLLMParams (ref: models/dense.py:150-167 + layers' TP splits,
+    layers/nvidia/tp_mlp.py:64-83 / tp_attn.py `_init_parameters`).
+
+    `path` holds config.json + model.safetensors (or the sharded
+    index). cfg defaults to config_from_hf(path). Returns params laid
+    out exactly like init_params — Engine, DenseLLM forward and the
+    megakernel consume them unchanged."""
+    cfg = cfg or config_from_hf(path)
+    n = int(mesh.shape[axis])
+    dt = jnp.dtype(dtype or cfg.dtype)
+    ckpt = _Checkpoint(path)
+    L = cfg.num_layers
+    h, d = cfg.hidden_size, cfg.head_dim
+    hq_l = cfg.num_q_heads // n
+    hkv_l = cfg.num_kv_heads // n
+    names = ckpt.names()
+
+    def shard_cols(w_t: np.ndarray, per: int) -> np.ndarray:
+        """(in, out) -> (n, in, per) column-parallel shards."""
+        return np.stack([w_t[:, r * per:(r + 1) * per] for r in range(n)])
+
+    def shard_rows(w_t: np.ndarray, per: int) -> np.ndarray:
+        """(in, out) -> (n, per, out) row-parallel shards."""
+        return np.stack([w_t[r * per:(r + 1) * per] for r in range(n)])
+
+    def proj(name: str) -> np.ndarray:
+        # HF Linear is (out, in); kernels consume (in, out)
+        return ckpt.get(name).T
+
+    embed = ckpt.get("model.embed_tokens.weight")
+    head_name = "lm_head.weight"
+    if cfg.tie_word_embeddings or head_name not in names:
+        head_t = embed.T  # (H, V)
+    else:
+        head_t = proj(head_name)
+    v_l = cfg.vocab_size // n
+    lm_head = shard_cols(head_t, v_l)
+
+    use_qk_norm = "model.layers.0.self_attn.q_norm.weight" in names
+    per_layer: Dict[str, list] = {k: [] for k in (
+        "input_ln", "post_attn_ln", "w_qkv", "w_o", "q_norm", "k_norm",
+        "w_gate", "w_up", "w_down", "w_gate_up", "w_router",
+    )}
+    for l in range(L):
+        p = f"model.layers.{l}."
+        per_layer["input_ln"].append(ckpt.get(p + "input_layernorm.weight"))
+        per_layer["post_attn_ln"].append(
+            ckpt.get(p + "post_attention_layernorm.weight"))
+        q_t = proj(p + "self_attn.q_proj.weight")  # (H, Hq*D)
+        k_t = proj(p + "self_attn.k_proj.weight")
+        v_t = proj(p + "self_attn.v_proj.weight")
+        qkv = np.concatenate([
+            shard_cols(q_t, hq_l * d),
+            shard_cols(k_t, hkv_l * d),
+            shard_cols(v_t, hkv_l * d),
+        ], axis=2)  # (n, H, (hq_l+2hkv_l)*D)
+        per_layer["w_qkv"].append(qkv)
+        per_layer["w_o"].append(
+            shard_rows(proj(p + "self_attn.o_proj.weight"), hq_l * d))
+        if use_qk_norm:
+            per_layer["q_norm"].append(
+                ckpt.get(p + "self_attn.q_norm.weight"))
+            per_layer["k_norm"].append(
+                ckpt.get(p + "self_attn.k_norm.weight"))
+        else:
+            ones = np.ones((d,), embed.dtype)
+            per_layer["q_norm"].append(ones)
+            per_layer["k_norm"].append(ones)
+        if cfg.is_moe:
+            e = cfg.num_experts
+            mi_l = cfg.moe_intermediate_size // n
+            per_layer["w_router"].append(proj(p + "mlp.gate.weight"))
+            gus, downs = [], []
+            for ei in range(e):
+                ep = f"{p}mlp.experts.{ei}."
+                g_t = proj(ep + "gate_proj.weight")  # (H, mi)
+                u_t = proj(ep + "up_proj.weight")
+                # fused per-rank [gate_r | up_r] (the grouped-GEMM
+                # expert layout, layers/tp_moe.py)
+                gus.append(np.concatenate([
+                    shard_cols(g_t, mi_l), shard_cols(u_t, mi_l)
+                ], axis=2))  # (n, H, 2mi_l)
+                downs.append(shard_rows(proj(ep + "down_proj.weight"),
+                                        mi_l))
+            per_layer["w_gate_up"].append(np.stack(gus, axis=1))
+            per_layer["w_down"].append(np.stack(downs, axis=1))
+        else:
+            i_l = cfg.intermediate_size // n
+            per_layer["w_gate"].append(
+                shard_cols(proj(p + "mlp.gate_proj.weight"), i_l))
+            per_layer["w_up"].append(
+                shard_cols(proj(p + "mlp.up_proj.weight"), i_l))
+            per_layer["w_down"].append(
+                shard_rows(proj(p + "mlp.down_proj.weight"), i_l))
+
+    def stack(key):
+        vals = per_layer[key]
+        return np.stack(vals) if vals else None
+
+    moe = cfg.is_moe
+    layers = DenseLayerParams(
+        input_ln=stack("input_ln"),
+        post_attn_ln=stack("post_attn_ln"),
+        w_qkv=stack("w_qkv"),
+        w_o=stack("w_o"),
+        q_norm=stack("q_norm"),
+        k_norm=stack("k_norm"),
+        w_down=stack("w_down"),
+        w_gate=None if moe else stack("w_gate"),
+        w_up=None if moe else stack("w_up"),
+        w_gate_up=stack("w_gate_up") if moe else None,
+        w_router=stack("w_router") if moe else None,
+    )
+    params = DenseLLMParams(
+        embed=embed, layers=layers, final_ln=ckpt.get("model.norm.weight"),
+        lm_head=lm_head,
+    )
+    specs = param_specs(axis, moe)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(
+            jnp.asarray(x, dt), NamedSharding(mesh, s)
+        ),
+        params, specs,
+    )
+
+
+class AutoLLM:
+    """Checkpoint-directory -> ready Engine (the reference's AutoLLM
+    name->class dispatch, models/__init__.py:33-50; the architecture
+    field of config.json plays the model_mapping key)."""
+
+    @staticmethod
+    def from_pretrained(path: str, mesh, axis: str = TP_AXIS, **engine_kw):
+        from triton_dist_tpu.models.engine import Engine
+
+        cfg = config_from_hf(path)
+        params = load_hf(path, mesh, cfg, axis)
+        return Engine(cfg, mesh, axis=axis, params=params, **engine_kw)
