@@ -155,9 +155,14 @@ def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int):
 
 def _maybe_prefetch(env: _Env, pf_code, pf_layer):
     """Start the next matmul's first weight tile (hinted by the queue
-    row). Branches that mark handles_prefetch issue it before their final
-    store drain (overlapping it); the dispatch wrapper covers the rest as
-    the task's final act (overlapping only the next task's input load)."""
+    row). Branches that mark handles_prefetch issue it as EARLY as their
+    own DMA ordering allows — right after queueing their input loads
+    (rms/silu/add/AR), after the last own weight tile is queued (matmul
+    nt>1; at nt==1 the epilogue, to not overwrite vpf while its own
+    prefetched tile is read), during the last KV load (attention), or
+    before the rank wait (barrier). Measured on the 8B decode chain,
+    early-within-task beats end-of-task by ~1.6%. The dispatch wrapper
+    covers any remaining branch as the task's final act."""
     for wi, (wname, K, TN) in enumerate(env.pf_specs):
         @pl.when(pf_code == wi + 1)
         def _(wname=wname, K=K, TN=TN):
@@ -225,6 +230,13 @@ def _matmul_branch(key, env: _Env):
         for j in range(nt):
             if j + 1 < nt:
                 wcopy(layer, j + 1, (j + 1) % 2).start()
+            if j == nt - 1 and nt > 1:
+                # all own tiles are queued: queue the next task's first
+                # weight tile NOW, before the last wait+dot, so the
+                # weight stream never drains at the task boundary. (At
+                # nt==1 this would overwrite vpf while this task's own
+                # prefetched tile is being read — epilogue issue below.)
+                _maybe_prefetch(env, args[6], args[7])
             if j == 0:
                 if pf_eligible:
                     def _from_prefetch():
@@ -255,9 +267,8 @@ def _matmul_branch(key, env: _Env):
             env.vout.at[:, pl.ds(0, N)], env.ws_rows(dst, N), env.st
         )
         st.start()
-        # issue the next task's weight prefetch BEFORE draining our store:
-        # the DMA rides the store wait + the next task's input load
-        _maybe_prefetch(env, args[6], args[7])
+        if nt == 1:
+            _maybe_prefetch(env, args[6], args[7])
         st.wait()
 
     body.handles_prefetch = True
@@ -279,6 +290,7 @@ def _rms_norm_branch(key, env: _Env):
         )
         cp_in.start()
         cp_w.start()
+        _maybe_prefetch(env, args[6], args[7])
         cp_in.wait()
         cp_w.wait()
         y = _rms_f32(env.vin[:, :W].astype(jnp.float32),
@@ -290,6 +302,7 @@ def _rms_norm_branch(key, env: _Env):
         st.start()
         st.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -302,6 +315,7 @@ def _silu_mul_branch(key, env: _Env):
             env.ws_rows(src, 2 * I), env.vin.at[:, pl.ds(0, 2 * I)], env.ld1
         )
         cp_in.start()
+        _maybe_prefetch(env, args[6], args[7])
         cp_in.wait()
         y = _silu_f32(env.vin[:, :I].astype(jnp.float32),
                       env.vin[:, I:2 * I].astype(jnp.float32))
@@ -312,6 +326,7 @@ def _silu_mul_branch(key, env: _Env):
         st.start()
         st.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -329,6 +344,7 @@ def _add_branch(key, env: _Env):
         )
         cp_a.start()
         cp_b.start()
+        _maybe_prefetch(env, args[6], args[7])
         cp_a.wait()
         cp_b.wait()
         env.vout[:, :W] = env.vin[:, :W] + env.vin2[:env.pb, :W]
@@ -338,6 +354,7 @@ def _add_branch(key, env: _Env):
         st.start()
         st.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -345,8 +362,12 @@ def _barrier_branch(key, env: _Env):
     _, axis, n = key
 
     def body(args):
+        # the pf DMA reads only local weights: issue it before waiting
+        # for the slowest rank, not after
+        _maybe_prefetch(env, args[6], args[7])
         shmem.barrier_all(axis)
 
+    body.handles_prefetch = True
     return body
 
 
@@ -372,6 +393,7 @@ def _allreduce_add_branch(key, env: _Env):
                 env.ld1,
             )
             cp_loc.start()
+            _maybe_prefetch(env, args[6], args[7])
             handles = []
             for i in range(1, n):
                 peer = jax.lax.rem(me + i, n)
@@ -397,6 +419,7 @@ def _allreduce_add_branch(key, env: _Env):
                 env.ws_rows(src, W), env.vin.at[:, pl.ds(0, W)], env.ld1
             )
             cp_loc.start()
+            _maybe_prefetch(env, args[6], args[7])
             cp_loc.wait()
             acc = env.vin[:, :W].astype(jnp.float32)
         cp_res.wait()
@@ -408,6 +431,7 @@ def _allreduce_add_branch(key, env: _Env):
         st.start()
         st.wait()
 
+    body.handles_prefetch = True
     return body
 
 
@@ -517,6 +541,10 @@ def _attention_branch(key, env: _Env):
             )
             cp_k.start()
             cp_v.start()
+            if h == hkv_l - 1:
+                # last KV load queued: stream the next matmul's first
+                # weight tile during this task's softmax compute
+                _maybe_prefetch(env, args[6], args[7])
             cp_k.wait()
             cp_v.wait()
             kf = env.vkv[0].astype(jnp.float32)  # (B, SMAX, D)
@@ -567,9 +595,6 @@ def _attention_branch(key, env: _Env):
         ]
         for cp in cps:
             cp.start()
-        # the attention->o_proj edge is the hottest prefetch site: issue
-        # it before draining our three stores
-        _maybe_prefetch(env, args[6], args[7])
         for cp in cps:
             cp.wait()
 
@@ -660,6 +685,13 @@ def compile_graph(
             (kk, tn), = name_dims[wname]
             pf_code_of[wname] = len(pf_specs) + 1
             pf_specs.append((wname, kk, tn))
+    # The pf hint rides the immediately preceding task's row. (Assigning
+    # it to the closest previous MATMUL instead — so the tile streams
+    # through intervening small tasks — was measured WORSE on the 32B
+    # model: the 3-5 MB pf tile head-of-line-blocks every intervening
+    # task's small input DMA in the shared HBM->VMEM queue. What helps
+    # is issuing EARLY WITHIN the task, after its own loads are queued —
+    # see the branch bodies.)
     for qi in range(len(order) - 1):
         nxt = tasks[order[qi + 1]]
         if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
