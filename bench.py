@@ -220,6 +220,48 @@ def bench_mlp(mesh, x, wg, wu, w2):
     return _chain_timer(build, (x, wg, wu, w2), pairs=5)
 
 
+def bench_a2a_dispatch(mesh):
+    """EP dispatch latency at the reference's latency-class shape (ref
+    README.md:93 / BASELINE.md row 1: 128 tok/rank, topk=8, hidden=7168,
+    fp8 wire — 137 us on 8 ranks). One real chip is available, so the
+    measured quantity is the world=1 kernel cost of the full dispatch
+    path (routing pack + fp8 quantize + a2a + unpack/dequant); the
+    cross-rank protocol itself is exercised by the 8-device dryrun.
+    Returns p50 microseconds."""
+    from triton_dist_tpu.kernels import ep_dispatch
+
+    M, H, K = 128, 7168, 8
+    n_experts = 16
+    capacity = M * K  # drop-free at world=1
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((M, H)) * 0.1, jnp.bfloat16)
+    ids = jnp.asarray(rng.integers(0, n_experts, (M, K)), jnp.int32)
+    w = jnp.asarray(rng.random((M, K)), jnp.float32)
+
+    def build(k):
+        def per_rank(x, ids, w):
+            def body(_, c):
+                disp = ep_dispatch(
+                    c, ids, w, n_experts, capacity, axis="tp",
+                    payload_dtype=jnp.float8_e4m3fn,
+                )
+                return disp.x[0, :M].astype(c.dtype)
+
+            out = jax.lax.fori_loop(0, k, body, x)
+            return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=mesh,
+                in_specs=(P(None), P(None), P(None)),
+                out_specs=P(None), check_vma=False,
+            )
+        )
+
+    ms, _ = _chain_timer(build, (x, ids, w), k_hi=51, pairs=5)
+    return ms * 1e3
+
+
 def bench_ag_gemm_kernel(mesh, x, w1):
     """Ratio of the forced Pallas AG+GEMM grid to the unfused XLA
     reference (all_gather + dot; plain matmul at world=1).
@@ -359,6 +401,10 @@ def main():
         result["pallas_vs_xla"] = round(ratio, 4)
     except Exception as e:
         result["secondary_metric_error"] = str(e)[:200]
+    try:
+        result["a2a_dispatch_us"] = round(bench_a2a_dispatch(mesh), 2)
+    except Exception as e:
+        result["a2a_dispatch_error"] = str(e)[:200]
 
     print(json.dumps(result))
 

@@ -92,39 +92,49 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
     bitcast into 8 lane-padding byte columns.
     """
     m, k = ids.shape
+    c = capacity
     flat_ids = ids.reshape(-1)
     dest = flat_ids // experts_per_rank  # (M*k,)
     order = jnp.argsort(dest, stable=True)
-    # position of each flat entry within its destination segment
     seg_count = jnp.bincount(dest, length=n_ranks)
     seg_start = jnp.cumsum(seg_count) - seg_count
-    sorted_dest = dest[order]
-    pos_in_seg = jnp.arange(m * k) - seg_start[sorted_dest]
-    keep = pos_in_seg < capacity  # overflow -> dropped
 
-    # Overflow entries get an out-of-range slot so mode="drop" discards
-    # them (clamping would overwrite the segment's last valid slot).
-    slot = jnp.where(
-        keep, sorted_dest * capacity + pos_in_seg, n_ranks * capacity
+    # GATHER formulation: for each send slot (d, p), the flat entry that
+    # fills it is order[seg_start[d] + p] (valid while p < seg_count[d]).
+    # The scatter formulation (send.at[slot].set) lowers to an XLA
+    # row-scatter that executes ~serially on TPU — measured 6.9 ms for
+    # the 128-token fp8 dispatch vs ~sub-ms for these dense gathers.
+    # Overflow (p >= capacity) is simply never gathered: same
+    # GShard-style drop semantics as before.
+    slot_dest = (jnp.arange(n_ranks * c) // c).astype(jnp.int32)
+    slot_pos = (jnp.arange(n_ranks * c) % c).astype(jnp.int32)
+    valid = slot_pos < jnp.minimum(seg_count, c)[slot_dest]
+    entry = order[jnp.minimum(seg_start[slot_dest] + slot_pos, m * k - 1)]
+    src_rows = jnp.where(valid, (entry // k).astype(jnp.int32), 0)
+    local_exp = jnp.where(
+        valid, (flat_ids[entry] % experts_per_rank).astype(jnp.int32), 0
     )
-    src_rows = (order // k).astype(jnp.int32)
-    local_exp = (flat_ids[order] % experts_per_rank).astype(jnp.int32)
-    w_flat = weights.reshape(-1)[order].astype(jnp.float32)
+    w_flat = jnp.where(
+        valid, weights.reshape(-1)[entry].astype(jnp.float32), 0.0
+    )
 
     h = x.shape[1]
     if _byte_wire(payload_dtype):
         # fp8 wire format: quantized tokens + bitcast (scale, expert id)
         q, scale = _quantize_fp8(x)
         h_pad = -(-(h + 8) // 128) * 128  # +8 byte columns of metadata
-        send_x = jnp.zeros((n_ranks * capacity, h_pad), payload_dtype)
-        send_x = send_x.at[slot, :h].set(q[src_rows], mode="drop")
+        tokens = jnp.where(valid[:, None], q[src_rows],
+                           jnp.zeros((), payload_dtype))
         meta = jnp.concatenate([
-            jax.lax.bitcast_convert_type(scale[src_rows], jnp.uint8),
+            jax.lax.bitcast_convert_type(
+                jnp.where(valid, scale[src_rows], 0.0), jnp.uint8),
             jax.lax.bitcast_convert_type(local_exp, jnp.uint8),
-        ], axis=-1)  # (M*k, 8)
-        send_x = send_x.at[slot, h:h + 8].set(
-            jax.lax.bitcast_convert_type(meta, payload_dtype), mode="drop"
-        )
+        ], axis=-1)  # (n*C, 8)
+        send_x = jnp.concatenate([
+            tokens,
+            jax.lax.bitcast_convert_type(meta, payload_dtype),
+            jnp.zeros((n_ranks * c, h_pad - h - 8), payload_dtype),
+        ], axis=-1)
     else:
         # Fold the travelling metadata (local expert id, the only field
         # the recv side needs) into lane-padding columns of the token
@@ -134,23 +144,18 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
             "expert id not exactly representable in bf16 lane padding"
         )
         h_pad = -(-(h + 1) // 128) * 128  # round_up(H+1, 128)
-        send_x = jnp.zeros((n_ranks * capacity, h_pad), x.dtype)
-        send_x = send_x.at[slot, :h].set(x[src_rows], mode="drop")
-        send_x = send_x.at[slot, h].set(
-            local_exp.astype(x.dtype), mode="drop"
-        )
-    send_row = jnp.zeros((n_ranks * capacity,), jnp.int32)
-    send_row = send_row.at[slot].set(src_rows, mode="drop")
-    send_w = jnp.zeros((n_ranks * capacity,), jnp.float32)
-    send_w = send_w.at[slot].set(w_flat, mode="drop")
-    valid = jnp.zeros((n_ranks * capacity,), jnp.bool_)
-    valid = valid.at[slot].set(True, mode="drop")
+        tokens = jnp.where(valid[:, None], x[src_rows],
+                           jnp.zeros((), x.dtype))
+        send_x = jnp.concatenate([
+            tokens,
+            local_exp.astype(x.dtype)[:, None],
+            jnp.zeros((n_ranks * c, h_pad - h - 1), x.dtype),
+        ], axis=-1)
     counts = jnp.minimum(seg_count, capacity).astype(jnp.int32)
-    c = capacity
     return (
         send_x.reshape(n_ranks, c, h_pad),
-        send_row.reshape(n_ranks, c),
-        send_w.reshape(n_ranks, c),
+        src_rows.reshape(n_ranks, c),
+        w_flat.reshape(n_ranks, c),
         valid.reshape(n_ranks, c),
         counts,
     )

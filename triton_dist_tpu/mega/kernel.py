@@ -70,8 +70,9 @@ ROW = 10  # queue row: [branch, a0..a5, pf_code, pf_layer, pf_in]
 # pf_*: cross-task weight prefetch (the reference's prefetch tasks, mega
 # kernels/prefetch.py, made implicit): the scheduler knows the next task
 # statically, so each row carries the NEXT matmul's weight id+layer; the
-# running task starts that first tile's DMA as its last act, and the next
-# matmul (pf_in=1) consumes it instead of issuing a cold load.
+# running task starts that first tile's DMA as early as its own DMA
+# ordering allows (see _maybe_prefetch), and the next matmul (pf_in=1)
+# consumes it instead of issuing a cold load.
 
 
 def _fit_tile(n: int, cap: int = 512) -> int:
@@ -161,8 +162,9 @@ def _maybe_prefetch(env: _Env, pf_code, pf_layer):
     nt>1; at nt==1 the epilogue, to not overwrite vpf while its own
     prefetched tile is read), during the last KV load (attention), or
     before the rank wait (barrier). Measured on the 8B decode chain,
-    early-within-task beats end-of-task by ~1.6%. The dispatch wrapper
-    covers any remaining branch as the task's final act."""
+    early-within-task beats end-of-task by ~1.6%. Every current branch
+    sets handles_prefetch; the dispatch wrapper's fallback only guards
+    future branches that forget to."""
     for wi, (wname, K, TN) in enumerate(env.pf_specs):
         @pl.when(pf_code == wi + 1)
         def _(wname=wname, K=K, TN=TN):
