@@ -149,3 +149,37 @@ def test_standalone_op_branches_mlp_graph():
 
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref(xv)),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_mega_long_context_chunked_kv():
+    """s_max=8192 engages the dynamic chunked-KV path (512-token pages,
+    trip count from max position); decode parity vs the XLA engine with
+    the prefill straddling a page boundary (ctx=513), and RAGGED batch
+    lengths (513, 200) so one sequence's pages are fully masked while
+    the other's are live."""
+    cfg = ModelConfig.tiny(max_positions=8192)
+    mesh = _mesh(1)
+    B, S = 2, 513
+    eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="xla",
+                 donate_cache=False, max_len=8192)
+    mega = MegaQwen3(cfg, mesh, batch=B, s_max=8192, params=eng.params,
+                     donate_cache=False)
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    logits_ref, cache_ref = eng.prefill(prompt)
+    # ragged lengths: sequence 1 only keeps its first 200 positions
+    # (entries past pos are masked identically by both implementations)
+    ragged = jnp.asarray([S, 200], jnp.int32)
+    cache_ref = cache_ref._replace(length=ragged)
+    mega_cache = MegaKVCache.from_dense(cache_ref, s_max=8192)
+
+    tok = jnp.argmax(logits_ref, -1).astype(jnp.int32)
+    for step in range(2):
+        logits_m, mega_cache = mega.decode_step(tok, mega_cache)
+        logits_x, cache_ref = eng.decode_step(tok, cache_ref)
+        np.testing.assert_allclose(
+            np.asarray(logits_m), np.asarray(logits_x),
+            rtol=2e-3, atol=2e-3, err_msg=f"long-ctx step {step}",
+        )
+        tok = jnp.argmax(logits_m, -1).astype(jnp.int32)

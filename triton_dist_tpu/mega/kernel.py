@@ -119,6 +119,7 @@ class _Env:
     st: Any = None
     wsems: Any = None
     kvsem: Any = None
+    kvsems: Any = None
     send: Any = None
     recv: Any = None
 
@@ -437,6 +438,15 @@ def _allreduce_add_branch(key, env: _Env):
     return body
 
 
+def _kv_chunk(smax: int) -> int:
+    """KV page length for the chunked attention: whole-cache at small
+    contexts (one page, the static path), 512-token pages past that."""
+    if smax <= 1024:
+        return smax
+    assert smax % 512 == 0, f"s_max {smax} must be a multiple of 512"
+    return 512
+
+
 def _attention_branch(key, env: _Env):
     """qk-norm + rope + GQA decode (ref: mega kernels/flash_attn.py page
     attention task). The new token's k/v rows are written to workspace
@@ -533,44 +543,106 @@ def _attention_branch(key, env: _Env):
         env.vout[:, hqdp + kwp:hqdp + kwp + kw] = pad_rows(
             vn.reshape(B, kw).astype(env.dtype))
 
-        out_rows = []  # per-b (1, hqd) attention outputs, kv-head-major
-        for h in range(hkv_l):
-            cp_k = pltpu.make_async_copy(
-                env.k_cache.at[layer, h], env.vkv.at[0], env.ld1
-            )
-            cp_v = pltpu.make_async_copy(
-                env.v_cache.at[layer, h], env.vkv.at[1], env.ld2
-            )
-            cp_k.start()
-            cp_v.start()
-            if h == hkv_l - 1:
-                # last KV load queued: stream the next matmul's first
-                # weight tile during this task's softmax compute
-                _maybe_prefetch(env, args[6], args[7])
-            cp_k.wait()
-            cp_v.wait()
-            kf = env.vkv[0].astype(jnp.float32)  # (B, SMAX, D)
-            vf = env.vkv[1].astype(jnp.float32)
+        # ---- chunked-KV online attention (flash-decode over the cache;
+        # ref: mega_triton_kernel/models/paged_kv_cache.py — context
+        # scales past VMEM by streaming SCHUNK-token KV pages). The
+        # online state is SEEDED with the new token's contribution
+        # (always unmasked), so the running max is real from the start
+        # and fully-masked chunks contribute exactly zero.
+        schunk = _kv_chunk(SMAX)
+        nch = SMAX // schunk
+
+        def kv_start(h, ci, slot):
+            for which, ref in ((0, env.k_cache), (1, env.v_cache)):
+                pltpu.make_async_copy(
+                    ref.at[layer, h, :, pl.ds(ci * schunk, schunk)],
+                    env.vkv.at[slot, which],
+                    env.kvsems.at[slot],
+                ).start()
+
+        def kv_wait(slot):
+            for which, ref in ((0, env.k_cache), (1, env.v_cache)):
+                pltpu.make_async_copy(
+                    ref.at[layer, 0, :, pl.ds(0, schunk)],
+                    env.vkv.at[slot, which],
+                    env.kvsems.at[slot],
+                ).wait()
+
+        def chunk_update(h, ci, state):
+            """One KV page folded into the per-b online softmax state."""
+            m, den, acc = state  # (B, g, 1), (B, g, 1), (B, g, D)
+            kf = env.vkv[ci % 2, 0].astype(jnp.float32)  # (B, schunk, D)
+            vf = env.vkv[ci % 2, 1].astype(jnp.float32)
+            ms, dens, accs = [], [], []
             for b in range(B):
                 qb = q[b, h * g:(h + 1) * g] * scale  # (g, D)
                 lg = jax.lax.dot_general(
                     qb, kf[b], (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                )  # (g, SMAX) over the cached prefix
-                spos = jax.lax.broadcasted_iota(jnp.int32, (g, SMAX), 1)
+                )  # (g, schunk)
+                spos = jax.lax.broadcasted_iota(
+                    jnp.int32, (g, schunk), 1) + ci * schunk
                 lg = jnp.where(spos < env.pos[b], lg, -1e30)
-                lg_new = jnp.sum(qb * kn[b, h][None, :], axis=-1,
-                                 keepdims=True)  # (g, 1)
-                m = jnp.maximum(jnp.max(lg, axis=-1, keepdims=True),
-                                lg_new)
-                p_ = jnp.exp(lg - m)
-                p_new = jnp.exp(lg_new - m)
-                denom = jnp.sum(p_, axis=-1, keepdims=True) + p_new
-                ob = jax.lax.dot_general(
+                m_new = jnp.maximum(m[b], jnp.max(lg, -1, keepdims=True))
+                alpha = jnp.exp(m[b] - m_new)
+                p_ = jnp.exp(lg - m_new)
+                ms.append(m_new)
+                dens.append(den[b] * alpha
+                            + jnp.sum(p_, -1, keepdims=True))
+                accs.append(acc[b] * alpha + jax.lax.dot_general(
                     p_, vf[b], (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                )  # (g, D)
-                ob = (ob + p_new * vn[b, h][None, :]) / denom
+                ))
+            return (jnp.stack(ms), jnp.stack(dens), jnp.stack(accs))
+
+        out_rows = []  # per-b (1, hqd) attention outputs, kv-head-major
+        for h in range(hkv_l):
+            # seed: the new token (logit lg_new, value vn) at weight 1
+            m0, d0, a0 = [], [], []
+            for b in range(B):
+                qb = q[b, h * g:(h + 1) * g] * scale
+                lg_new = jnp.sum(qb * kn[b, h][None, :], axis=-1,
+                                 keepdims=True)  # (g, 1)
+                m0.append(lg_new)
+                d0.append(jnp.ones_like(lg_new))
+                a0.append(jnp.broadcast_to(vn[b, h][None, :], (g, D)))
+            state = (jnp.stack(m0), jnp.stack(d0), jnp.stack(a0))
+
+            if nch == 1:
+                # static path (whole cache is one page; bench shapes)
+                kv_start(h, 0, 0)
+                if h == hkv_l - 1:
+                    _maybe_prefetch(env, args[6], args[7])
+                kv_wait(0)
+                state = chunk_update(h, 0, state)
+            else:
+                # long-context path: dynamic trip count — only pages
+                # that intersect some sequence's prefix are touched
+                maxp = env.pos[0]
+                for b in range(1, B):
+                    maxp = jnp.maximum(maxp, env.pos[b])
+                n_act = jnp.minimum((maxp + schunk - 1) // schunk, nch)
+
+                @pl.when(n_act > 0)
+                def _first():
+                    kv_start(h, 0, 0)
+
+                if h == hkv_l - 1:
+                    _maybe_prefetch(env, args[6], args[7])
+
+                def loop_body(ci, state):
+                    @pl.when(ci + 1 < n_act)
+                    def _ahead():
+                        kv_start(h, ci + 1, (ci + 1) % 2)
+
+                    kv_wait(ci % 2)
+                    return chunk_update(h, ci, state)
+
+                state = jax.lax.fori_loop(0, n_act, loop_body, state)
+
+            _, den, acc = state
+            for b in range(B):
+                ob = acc[b] / den[b]
                 if h == 0:
                     out_rows.append([ob.reshape(1, g * D)])
                 else:
@@ -719,6 +791,7 @@ def compile_graph(
         half = D // 2
     else:
         hkv_l, D, SMAX, half = 1, 128, 8, 64
+    SCHUNK = _kv_chunk(SMAX)
     ar_keys = [k for k in branch_keys if k[0] in ("allreduce_add",
                                                   "barrier")]
     arw = max((k[1] for k in ar_keys if k[0] == "allreduce_add"),
@@ -740,7 +813,7 @@ def compile_graph(
         pf_kmax * pf_tnmax * isz +
         4 * PB * wmax * max(isz, 4)
         + 2 * kmax * tnmax * isz
-        + 2 * B * SMAX * D * isz
+        + min(2, SMAX // SCHUNK) * 2 * B * SCHUNK * D * isz
         + 2 * world * PB * arw * isz
         + (4 << 20)
     )
@@ -751,7 +824,7 @@ def compile_graph(
         (norms, rope_cs, k_cache, v_cache,
          ws_out,
          vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
-         ld1, ld2, st, wsems, kvsem, send, recv, pfsem) = rest[nw:]
+         ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem) = rest[nw:]
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
@@ -761,7 +834,8 @@ def compile_graph(
             vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, vpf=vpf,
             pfsem=pfsem, pf_specs=pf_specs, mailbox=mailbox,
             ld1=ld1, ld2=ld2,
-            st=st, wsems=wsems, kvsem=kvsem, send=send, recv=recv,
+            st=st, wsems=wsems, kvsem=kvsem, kvsems=kvsems, send=send,
+            recv=recv,
         )
         bodies = [_BRANCH_BUILDERS[k[0]](k, env) for k in branch_keys]
         ti = pl.program_id(0)
@@ -791,7 +865,10 @@ def compile_graph(
                                                          #  norm vectors)
                 pltpu.VMEM((PB, wmax), dtype),           # vout
                 pltpu.VMEM((2, kmax, tnmax), dtype),     # vw double buffer
-                pltpu.VMEM((2, B, SMAX, D), dtype),      # vkv
+                # KV page slots: 1 when the whole cache is one page,
+                # a double buffer on the chunked long-context path
+                pltpu.VMEM((min(2, SMAX // SCHUNK), 2, B, SCHUNK, D),
+                           dtype),
                 pltpu.VMEM((B, 8, D), jnp.float32),      # vrope stripes
                 # f32 8-row stripes (see _rms_norm_branch)
                 pltpu.VMEM((8, norm_width), jnp.float32),  # vnq
@@ -803,6 +880,8 @@ def compile_graph(
                 pltpu.SemaphoreType.DMA,                 # st
                 pltpu.SemaphoreType.DMA((2,)),           # wsems
                 pltpu.SemaphoreType.DMA,                 # kvsem
+                pltpu.SemaphoreType.DMA(                 # kvsems (pages)
+                    (min(2, SMAX // SCHUNK),)),
                 pltpu.SemaphoreType.DMA,                 # send
                 pltpu.SemaphoreType.DMA((2,)),           # recv (per-parity)
                 pltpu.SemaphoreType.DMA,                 # pfsem
