@@ -96,6 +96,41 @@ def test_mega_greedy_matches_engine(tiny_cfg):
     np.testing.assert_array_equal(np.stack(toks_e), np.stack(toks_m))
 
 
+@pytest.mark.parametrize("world", [1, 4])
+def test_mega_decode_two_cores_matches_engine(tiny_cfg, world,
+                                              monkeypatch):
+    """The 2-queue scoreboard kernel (interpreted with two concurrent
+    core threads) decodes identically to the XLA engine — cross-core
+    watermark waits, the HB slot plan, and the drain rows all execute.
+    Race detection is enabled at world=1 (it slows the interpreter;
+    one world covers the data-race question)."""
+    if world == 1:
+        monkeypatch.setenv("TDT_MEGA_RACES", "1")
+    cfg = tiny_cfg
+    mesh = _mesh(world)
+    B, S = (2, 5) if world == 1 else (4, 4)
+    eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="xla",
+                 donate_cache=False, max_len=32)
+    mega = MegaQwen3(cfg, mesh, batch=B, s_max=32, params=eng.params,
+                     donate_cache=False, num_cores=2)
+    assert mega.sched.num_cores == 2
+    assert all(len(q) > 0 for q in mega.sched.queues)
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    logits_ref, cache_ref = eng.prefill(prompt)
+    mcache = MegaKVCache.from_dense(cache_ref, s_max=32)
+    tok = jnp.argmax(logits_ref, -1).astype(jnp.int32)
+    for step in range(2):
+        lm, mcache = mega.decode_step(tok, mcache)
+        lx, cache_ref = eng.decode_step(tok, cache_ref)
+        np.testing.assert_allclose(
+            np.asarray(lm), np.asarray(lx), rtol=2e-3, atol=2e-3,
+            err_msg=f"2-core decode step {step} (world={world})",
+        )
+        tok = jnp.argmax(lm, -1).astype(jnp.int32)
+
+
 def test_standalone_op_branches_mlp_graph():
     """The standalone rms_norm / silu_mul / add / matmul branches stay
     exercised (the Qwen3 graph now uses fused prologues; these ops remain

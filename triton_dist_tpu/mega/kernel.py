@@ -17,13 +17,17 @@ and kernels/task_context.py:92-140). The mapping:
                                     activation arena planned by
                                     tdt_plan_slots) + layer ids indexing
                                     stacked weight arrays
-  scoreboard signal table        -> same-core program order (single-core
-                                    queues are topologically sorted);
+  scoreboard signal table        -> same-core program order within one
+                                    queue (topologically sorted); ACROSS
+                                    cores, per-queue completion counts on
+                                    a regular-semaphore scoreboard: each
+                                    task broadcasts "queue c finished its
+                                    k-th task" and waiters consume static
+                                    watermark deltas (see compile_graph;
+                                    the ref's device scoreboard,
+                                    kernels/task_context.py:92-140);
                                     cross-chip AR uses remote DMA delivery
-                                    semaphores; multi-core watermark
-                                    execution is planned by the scheduler
-                                    but not yet lowered (v5e/v6e chips are
-                                    single-TensorCore)
+                                    semaphores
   in-kernel multimem allreduce   -> one-shot mailbox AR over ICI remote
                                     DMA, parity-double-buffered across
                                     decode steps (ref mega
@@ -47,6 +51,7 @@ the same reason: the cache write is not on the kernel's critical path).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, List
 
 import jax
@@ -64,7 +69,7 @@ from triton_dist_tpu.lang.core import (
     tpu_call,
 )
 from triton_dist_tpu.mega.core import Graph
-from triton_dist_tpu.mega.scheduler import Schedule
+from triton_dist_tpu.mega.scheduler import Schedule, monotone_watermarks
 
 ROW = 10  # queue row: [branch, a0..a5, pf_code, pf_layer, pf_in]
 # pf_*: cross-task weight prefetch (the reference's prefetch tasks, mega
@@ -676,6 +681,17 @@ def _attention_branch(key, env: _Env):
     return body
 
 
+def _noop_branch(key, env: _Env):
+    """Multi-core filler: drain rows (whose scoreboard waits happen in the
+    dispatch wrapper) and queue padding execute this empty body."""
+
+    def body(args):
+        _maybe_prefetch(env, args[6], args[7])
+
+    body.handles_prefetch = True
+    return body
+
+
 _BRANCH_BUILDERS: Dict[str, Callable] = {
     "matmul": _matmul_branch,
     "rms_norm": _rms_norm_branch,
@@ -684,6 +700,7 @@ _BRANCH_BUILDERS: Dict[str, Callable] = {
     "allreduce_add": _allreduce_add_branch,
     "attention": _attention_branch,
     "barrier": _barrier_branch,
+    "noop": _noop_branch,
 }
 
 
@@ -720,12 +737,11 @@ def compile_graph(
     B = graph.batch
     PB = round_up(B, min_tile(dtype)[0])
     tasks = graph.tasks
-    if sched.watermarks.shape[1] != 1:
-        raise NotImplementedError(
-            "megakernel execution currently lowers single-core queues; "
-            "multi-core schedules are planner-only (v5e/v6e have one "
-            "TensorCore per chip)"
-        )
+    nc = int(sched.watermarks.shape[1])
+    # multi-core rows append the scoreboard plan: nc wait-delta columns
+    # (consume this many completions of queue c' before starting) and one
+    # broadcast flag (announce completion to every core)
+    row_len = ROW + (nc + 1 if nc > 1 else 0)
 
     # branch table: first-seen order over the scheduled queue
     branch_keys: List[Any] = []
@@ -734,17 +750,16 @@ def compile_graph(
         if t.branch_key not in branch_of:
             branch_of[t.branch_key] = len(branch_keys)
             branch_keys.append(t.branch_key)
+    if nc > 1 and ("noop",) not in branch_of:
+        branch_of[("noop",)] = len(branch_keys)
+        branch_keys.append(("noop",))
 
-    # queue rows in schedule order, buffer args rewritten to slots
-    order = sched.order
-    queue = np.zeros((len(order), ROW), np.int32)
-    for qi, tid in enumerate(order):
-        t = tasks[tid]
+    def base_row(t):
         row = [branch_of[t.branch_key]] + list(t.args)
         row += [0] * (ROW - len(row))
         for pos_ in t.buf_args:
             row[1 + pos_] = int(sched.buf_slot[row[1 + pos_]])
-        queue[qi] = row[:ROW]
+        return row[:ROW]
 
     # cross-task weight prefetch hints (see ROW comment): a weight is
     # prefetchable only when every matmul using it shares one (K, TN)
@@ -759,19 +774,67 @@ def compile_graph(
             (kk, tn), = name_dims[wname]
             pf_code_of[wname] = len(pf_specs) + 1
             pf_specs.append((wname, kk, tn))
-    # The pf hint rides the immediately preceding task's row. (Assigning
-    # it to the closest previous MATMUL instead — so the tile streams
-    # through intervening small tasks — was measured WORSE on the 32B
-    # model: the 3-5 MB pf tile head-of-line-blocks every intervening
-    # task's small input DMA in the shared HBM->VMEM queue. What helps
-    # is issuing EARLY WITHIN the task, after its own loads are queued —
-    # see the branch bodies.)
-    for qi in range(len(order) - 1):
-        nxt = tasks[order[qi + 1]]
-        if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
-            queue[qi, 7] = pf_code_of[nxt.branch_key[1]]
-            queue[qi, 8] = nxt.args[0]  # layer
-            queue[qi + 1, 9] = 1        # consumer: first tile prefetched
+
+    def assign_pf_hints(q2d, tids):
+        # The pf hint rides the immediately preceding task's row IN THE
+        # SAME QUEUE (vpf is per-core VMEM: hint and consumer must share
+        # a core). (Assigning it to the closest previous MATMUL instead —
+        # so the tile streams through intervening small tasks — was
+        # measured WORSE on the 32B model: the 3-5 MB pf tile
+        # head-of-line-blocks every intervening task's small input DMA in
+        # the shared HBM->VMEM queue. What helps is issuing EARLY WITHIN
+        # the task, after its own loads are queued — see the branch
+        # bodies.)
+        for qi in range(len(tids) - 1):
+            nxt = tasks[tids[qi + 1]]
+            if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
+                q2d[qi, 7] = pf_code_of[nxt.branch_key[1]]
+                q2d[qi, 8] = nxt.args[0]  # layer
+                q2d[qi + 1, 9] = 1        # consumer: first tile prefetched
+
+    order = sched.order
+    if nc == 1:
+        # queue rows in schedule order, buffer args rewritten to slots
+        queue = np.zeros((len(order), ROW), np.int32)
+        for qi, tid in enumerate(order):
+            queue[qi] = base_row(tasks[tid])
+        assign_pf_hints(queue, order)
+        qmax = len(order)
+    else:
+        # per-core queues + scoreboard plan. Queue identity (program_id 0)
+        # is decoupled from PHYSICAL core identity (the interpreter
+        # randomizes the parallel-coordinate -> core assignment; Mosaic's
+        # megacore split is its own choice), so completions are BROADCAST:
+        # finishing a task of queue c signals scoreboard semaphore sb[c]
+        # on every core, and a waiter consumes from its local instance —
+        # whichever core it landed on. Watermarks are monotonized along
+        # each queue (scheduler.monotone_watermarks) so each row's wait is
+        # a static DELTA, and a final drain row per queue returns every
+        # local semaphore instance to zero (Mosaic requires semaphores
+        # drained at kernel exit).
+        wm_mono = monotone_watermarks(sched)
+        qlens = [len(q) for q in sched.queues]
+        qmax = max(qlens) + 1  # +1 for the drain row
+        queue = np.zeros((nc, qmax, row_len), np.int32)
+        noop_row = [branch_of[("noop",)]] + [0] * (row_len - 1)
+        for c, qtasks in enumerate(sched.queues):
+            prev = np.zeros(nc, np.int64)
+            for p, tid in enumerate(qtasks):
+                r = base_row(tasks[tid]) + [0] * (nc + 1)
+                for c2 in range(nc):
+                    if c2 != c:
+                        r[ROW + c2] = int(wm_mono[tid][c2] - prev[c2])
+                prev = np.maximum(prev, wm_mono[tid])
+                r[ROW + nc] = 1  # broadcast completion
+                queue[c, p] = r
+            dr = list(noop_row)
+            for c2 in range(nc):
+                dr[ROW + c2] = (qlens[c] if c2 == c
+                                else int(qlens[c2] - prev[c2]))
+            queue[c, qlens[c]] = dr
+            for p in range(qlens[c] + 1, qmax):
+                queue[c, p] = noop_row
+            assign_pf_hints(queue[c], qtasks)
 
     # static dims
     wmax = round_up(max(b.width for b in graph.buffers), 128)
@@ -821,10 +884,14 @@ def compile_graph(
     def kernel(q_ref, pos_ref, ws_in, *rest):
         nw = len(weight_names)
         w_refs = rest[:nw]
+        tail = rest[nw:]
+        if nc > 1:
+            sb = tail[-1]
+            tail = tail[:-1]
         (norms, rope_cs, k_cache, v_cache,
          ws_out,
          vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
-         ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem) = rest[nw:]
+         ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem) = tail
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
@@ -838,24 +905,55 @@ def compile_graph(
             recv=recv,
         )
         bodies = [_BRANCH_BUILDERS[k[0]](k, env) for k in branch_keys]
-        ti = pl.program_id(0)
-        a = [q_ref[ti, j] for j in range(1, ROW)]
+        if nc > 1:
+            ci = pl.program_id(0)
+            ti = pl.program_id(1)
+
+            def row(j):
+                return q_ref[ci, ti, j]
+        else:
+            ti = pl.program_id(0)
+
+            def row(j):
+                return q_ref[ti, j]
+
+        a = [row(j) for j in range(1, ROW)]
+
+        if nc > 1:
+            # scoreboard waits: consume the planned delta of completions
+            # of each other queue from the LOCAL semaphore instance
+            for c2 in range(nc):
+                delta = row(ROW + c2)
+
+                @pl.when(delta > 0)
+                def _(c2=c2, delta=delta):
+                    pltpu.semaphore_wait(sb.at[c2], delta)
 
         def dispatch(f):
             f(a)
             if not getattr(f, "handles_prefetch", False):
                 _maybe_prefetch(env, a[6], a[7])
 
-        jax.lax.switch(q_ref[ti, 0],
-                       [lambda f=f: dispatch(f) for f in bodies])
+        jax.lax.switch(row(0), [lambda f=f: dispatch(f) for f in bodies])
+
+        if nc > 1:
+            sig = row(ROW + nc)
+
+            @pl.when(sig > 0)
+            def _():
+                # broadcast completion of queue `ci` to every core's
+                # instance of sb[ci] (queue id != physical core id)
+                for c2 in range(nc):
+                    pltpu.semaphore_signal(sb.at[ci], 1, core_index=c2)
 
     def run(pos, ws, weights: Dict[str, jax.Array], norms, rope_cs,
             k, v):
         any_spec = pl.BlockSpec(memory_space=pl.ANY)
         nw = len(weight_names)
+        grid = (nc, qmax) if nc > 1 else (len(order),)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(len(order),),
+            grid=grid,
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
             + [any_spec] * (1 + nw + 4),
             out_specs=any_spec,
@@ -885,8 +983,29 @@ def compile_graph(
                 pltpu.SemaphoreType.DMA,                 # send
                 pltpu.SemaphoreType.DMA((2,)),           # recv (per-parity)
                 pltpu.SemaphoreType.DMA,                 # pfsem
-            ],
+            ] + (
+                # multi-core scoreboard: sb[c] counts queue c completions
+                [pltpu.SemaphoreType.REGULAR((nc,))] if nc > 1 else []
+            ),
         )
+        extra: Dict[str, Any] = {}
+        if nc > 1:
+            from triton_dist_tpu.lang.core import use_interpret
+
+            if use_interpret():
+                extra["interpret"] = pltpu.InterpretParams(
+                    num_cores_or_threads=nc,
+                    detect_races=os.environ.get("TDT_MEGA_RACES") == "1",
+                )
+            else:
+                phys = getattr(jax.devices()[0], "num_cores", 1) or 1
+                if phys < nc:
+                    raise RuntimeError(
+                        f"megakernel schedule uses {nc} cores but this "
+                        f"chip has {phys} TensorCore(s); re-schedule with "
+                        f"num_cores={phys} (multi-core needs v4/v5p-class "
+                        "megacore chips)"
+                    )
         fn = tpu_call(
             kernel,
             grid_spec=grid_spec,
@@ -898,8 +1017,12 @@ def compile_graph(
                 collective_id=next_collective_id(name) if world > 1
                 else None,
                 vmem_limit_bytes=int(vmem),
-                dimension_semantics=("arbitrary",),
+                dimension_semantics=(
+                    ("parallel", "arbitrary") if nc > 1
+                    else ("arbitrary",)
+                ),
             ),
+            **extra,
         )
         w_list = [weights[n] for n in weight_names]
         return fn(jnp.asarray(queue), pos, ws, *w_list, norms, rope_cs,

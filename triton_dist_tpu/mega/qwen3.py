@@ -138,6 +138,7 @@ class MegaQwen3:
         seed: int = 0,
         fast_init: bool = False,
         donate_cache: bool = True,
+        num_cores: int = 1,
     ):
         assert not cfg.is_moe, "megakernel covers the dense decode graph"
         from triton_dist_tpu.lang.core import use_interpret
@@ -185,7 +186,7 @@ class MegaQwen3:
 
         mb, meta = build_qwen3_graph(cfg, batch, n, self.s_max, axis)
         self.graph = mb.graph
-        sched = schedule_graph(self.graph)
+        sched = schedule_graph(self.graph, num_cores=num_cores)
         validate_schedule(self.graph, sched)
         self.sched = sched
         self.cm: CompiledMega = compile_graph(

@@ -23,7 +23,7 @@ from __future__ import annotations
 import ctypes
 import dataclasses
 import heapq
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +43,10 @@ class Schedule:
     buf_slot: np.ndarray     # (n_bufs,) workspace slot per buffer
     n_slots: int
     native: bool             # True when produced by the C++ scheduler
+
+    @property
+    def num_cores(self) -> int:
+        return int(self.watermarks.shape[1])
 
 
 def _i32(a) -> np.ndarray:
@@ -118,6 +122,139 @@ def _py_watermarks(n, edges, core, pos, num_cores):
             continue
         wm[d, core[s]] = max(wm[d, core[s]], pos[s] + 1)
     return wm
+
+
+def monotone_watermarks(sched: "Schedule") -> np.ndarray:
+    """Watermarks rewritten as a running max along each core queue.
+
+    Waiting for the running max blocks no longer than the original wait
+    (every earlier task on the queue already waited for its own watermark,
+    so by the time task d runs, progress has reached the prefix max) and
+    makes consumed-count tracking static: the kernel's per-row wait is
+    simply wm_mono[d] - wm_mono[previous row], a compile-time delta."""
+    wm = np.array(sched.watermarks, np.int32, copy=True)
+    for q in sched.queues:
+        run = np.zeros(wm.shape[1], np.int32)
+        for t in q:
+            run = np.maximum(run, wm[t])
+            wm[t] = run
+    return wm
+
+
+INF_POS = 1 << 30
+
+
+def after_vectors(sched: "Schedule", wm_mono: np.ndarray) -> np.ndarray:
+    """A[t, c] = the smallest queue position p such that task (c, p) is
+    guaranteed to START strictly after task t COMPLETES (INF_POS if no
+    such task). This is the happens-before closure of the multi-core
+    execution order — same-core program order plus scoreboard watermark
+    waits — used by the slot planner to prove that a workspace slot's
+    previous tenant is fully drained before its next definer can run.
+
+    At num_cores=1 this degenerates to A[t, 0] = pos[t] + 1 and the
+    planner below reproduces the linear-interval planner exactly."""
+    n, nc = wm_mono.shape
+    core = np.asarray(sched.core)
+    pos = np.asarray(sched.pos)
+    # HB successor edges on tasks: same-core next task, plus each task u
+    # whose (monotone) watermark on core c equals p+1 starts after task
+    # (c, p) completes. Larger watermarks are reached transitively.
+    succ: List[List[int]] = [[] for _ in range(n)]
+    by_cp = {(int(core[t]), int(pos[t])): t for t in range(n)}
+    for q in sched.queues:
+        for a, b in zip(q, q[1:]):
+            succ[a].append(b)
+    for u in range(n):
+        for c in range(nc):
+            w = int(wm_mono[u, c])
+            if w > 0 and c != core[u]:
+                succ[by_cp[(c, w - 1)]].append(u)
+    indeg = np.zeros(n, np.int64)
+    for t in range(n):
+        for s in succ[t]:
+            indeg[s] += 1
+    topo = [t for t in range(n) if indeg[t] == 0]
+    head = 0
+    while head < len(topo):
+        t = topo[head]
+        head += 1
+        for s in succ[t]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                topo.append(s)
+    assert len(topo) == n, "cycle in happens-before graph"
+    A = np.full((n, nc), INF_POS, np.int64)
+    for t in reversed(topo):
+        for s in succ[t]:
+            # start(s) is after comp(t): s's own position counts, and
+            # everything after comp(s) is after start(s) >= comp(t)
+            A[t] = np.minimum(A[t], A[s])
+            A[t, core[s]] = min(A[t, core[s]], pos[s])
+    return A
+
+
+def _buffer_users(graph: Graph) -> Tuple[List[int], List[List[int]]]:
+    """(defining task per buffer (-1 if external), every accessing task
+    per buffer) — shared by the HB slot planner and its validator, whose
+    agreement the multi-core slot safety argument depends on."""
+    nb = len(graph.buffers)
+    def_task = [-1] * nb
+    users: List[List[int]] = [[] for _ in range(nb)]
+    for t in graph.tasks:
+        for b in t.writes:
+            if def_task[b] < 0:
+                def_task[b] = t.id
+            users[b].append(t.id)
+        for b in t.reads:
+            users[b].append(t.id)
+    return def_task, users
+
+
+def _py_plan_slots_hb(graph: Graph, sched: "Schedule",
+                      A: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Slot planning under concurrent cores: slot reuse is legal only
+    when every task touching the previous tenant happens-before the new
+    tenant's defining task (proved via the `after_vectors` closure, not
+    linear order — two tasks adjacent in the core-major order may run
+    CONCURRENTLY on different cores)."""
+    nb = len(graph.buffers)
+    nc = A.shape[1]
+    core = np.asarray(sched.core)
+    pos = np.asarray(sched.pos)
+    gpos = {t: i for i, t in enumerate(sched.order)}
+    def_task, users = _buffer_users(graph)
+    order_b = sorted(range(nb),
+                     key=lambda b: gpos.get(def_task[b], -1))
+    slot = np.zeros(nb, np.int64)
+    # release[s][c] = min position on core c from which a new tenant's
+    # def task may start (max over the old tenant's users' A vectors)
+    release: List[np.ndarray] = []
+    for b in order_b:
+        pinned = graph.pinned.get(b, False)
+        d = def_task[b]
+        chosen = -1
+        if not pinned and d >= 0:
+            for s, rel in enumerate(release):
+                if rel is None:
+                    continue  # pinned slot
+                if pos[d] >= rel[core[d]]:
+                    chosen = s
+                    break
+        if chosen < 0:
+            chosen = len(release)
+            release.append(np.zeros(nc, np.int64))
+        slot[b] = chosen
+        if pinned:
+            release[chosen] = None
+        else:
+            rel = np.zeros(nc, np.int64)
+            for u in users[b]:
+                rel = np.maximum(rel, A[u])
+            if not users[b]:
+                rel[:] = INF_POS  # unused buffer: never reusable safely
+            release[chosen] = rel
+    return np.array(slot, np.int32), len(release)
 
 
 def _py_plan_slots(ndef, last, pinned):
@@ -200,6 +337,20 @@ def schedule_graph(
         q.sort(key=lambda t: pos[t])
     order = [t for q in queues for t in q]
 
+    if num_cores > 1:
+        # concurrent queues: interval liveness over the core-major order
+        # is unsound (adjacent order positions may run concurrently on
+        # different cores) — plan via the happens-before closure instead
+        sched = Schedule(core=np.asarray(core), pos=np.asarray(pos),
+                         watermarks=wm, order=order, queues=queues,
+                         buf_slot=np.zeros(len(graph.buffers), np.int32),
+                         n_slots=0, native=lib is not None)
+        slot, n_slots = _py_plan_slots_hb(
+            graph, sched, after_vectors(sched, monotone_watermarks(sched)))
+        sched.buf_slot = slot
+        sched.n_slots = int(n_slots)
+        return sched
+
     ndef, last = graph.liveness(order)
     pinned = [graph.pinned.get(b.id, False) for b in graph.buffers]
     if lib is not None:
@@ -226,12 +377,16 @@ def schedule_graph(
 def validate_schedule(graph: Graph, sched: Schedule) -> None:
     """Sanity invariants (tests + compile-time assert): every dep either
     precedes its consumer on the same core or carries a watermark; no two
-    live buffers share a slot."""
+    buffers sharing a slot can be live concurrently (proved by interval
+    order at one core, by the happens-before closure under many)."""
     for s, d in graph.edges:
         if sched.core[s] == sched.core[d]:
             assert sched.pos[s] < sched.pos[d], (s, d)
         else:
             assert sched.watermarks[d, sched.core[s]] >= sched.pos[s] + 1
+    if sched.num_cores > 1:
+        _validate_slots_hb(graph, sched)
+        return
     ndef, last = graph.liveness(sched.order)
     by_slot: dict = {}
     for b in graph.buffers:
@@ -244,3 +399,30 @@ def validate_schedule(graph: Graph, sched: Schedule) -> None:
                 f"slot {slot}: buffers {b1} and {b2} overlap "
                 f"([{d1},{l1}] vs [{d2},{l2}])"
             )
+
+
+def _validate_slots_hb(graph: Graph, sched: Schedule) -> None:
+    """Multi-core slot check: for each pair of buffers sharing a slot,
+    one buffer's every accessor must happen-before the other's defining
+    task (recomputed independently of the planner's choices)."""
+    A = after_vectors(sched, monotone_watermarks(sched))
+    core = np.asarray(sched.core)
+    pos = np.asarray(sched.pos)
+    def_task, users = _buffer_users(graph)
+
+    def all_before(b1: int, b2: int) -> bool:
+        d = def_task[b2]
+        if d < 0:
+            return False
+        return all(pos[d] >= A[u][core[d]] for u in users[b1])
+
+    by_slot: dict = {}
+    for b in graph.buffers:
+        by_slot.setdefault(int(sched.buf_slot[b.id]), []).append(b.id)
+    for slot, bufs in by_slot.items():
+        for i, b1 in enumerate(bufs):
+            for b2 in bufs[i + 1:]:
+                assert all_before(b1, b2) or all_before(b2, b1), (
+                    f"slot {slot}: buffers {b1} and {b2} may be live "
+                    "concurrently under the multi-core schedule"
+                )
