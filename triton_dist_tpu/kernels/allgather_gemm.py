@@ -94,7 +94,7 @@ def _silu_mul_f32(g, u):
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
                     need_ws: bool, cache_a: bool, silu_pair: bool,
-                    arrival: bool, *refs):
+                    arrival: bool, grouped: bool, *refs):
     refs = list(refs)
     a_ref, b_ref = refs[:2]
     del refs[:2]
@@ -253,12 +253,16 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         if silu_pair:
             acc2[...] = jnp.zeros_like(acc2)
 
+    # grouped mode: b blocks are (1, tk, tn) slices of a per-expert weight
+    # stack, selected by the M-tile's expert (block-diagonal grouped GEMM)
+    b_tile = b_ref[0] if grouped else b_ref[...]
     acc[...] += jnp.dot(
-        a_tile, b_ref[...], preferred_element_type=jnp.float32
+        a_tile, b_tile, preferred_element_type=jnp.float32
     )
     if silu_pair:
+        b2_tile = b2_ref[0] if grouped else b2_ref[...]
         acc2[...] += jnp.dot(
-            a_tile, b2_ref[...], preferred_element_type=jnp.float32
+            a_tile, b2_tile, preferred_element_type=jnp.float32
         )
 
     # --- store the finished output tile.
@@ -341,24 +345,52 @@ def ag_gemm(
         )
         b_gate, b_up = b
         assert b_gate.shape == b_up.shape
-        k2, i_loc = b_gate.shape
-        n_loc = 2 * i_loc
+        shp = b_gate.shape
         assert not return_gathered, "silu_pair does not return gathered A"
     else:
-        k2, n_loc = b.shape
-        i_loc = n_loc
+        shp = b.shape
+    # 3-D b is the GROUPED form (E, K, N_loc): a_shard rows are E
+    # fixed-capacity expert blocks (moe_utils.pack_by_expert) and block e
+    # multiplies b[e] — the fused AG + grouped GEMM of the MoE pair
+    # (ref: kernels/nvidia/allgather_group_gemm.py:535 consumer; the ring
+    # machinery is shared with the dense kernel, per-segment waits become
+    # the same per-ring-step DMA semaphores).
+    grouped = len(shp) == 3
+    e_groups = shp[0] if grouped else 1
+    k2, width = shp[-2], shp[-1]
+    i_loc = width
+    n_loc = 2 * width if silu_pair else width
     assert k == k2, f"K mismatch {k} vs {k2}"
+    if grouped:
+        assert m_loc % e_groups == 0, (
+            f"packed rows {m_loc} must be E={e_groups} equal blocks"
+        )
+    cap_pad = m_loc // e_groups
+
+    def _grouped_dot(a_full, w):
+        # batched per-expert dot: (E, n*cap, K) x (E, K, N) on the MXU
+        xe = jnp.moveaxis(
+            a_full.reshape(n, e_groups, cap_pad, k), 1, 0
+        ).reshape(e_groups, n * cap_pad, k)
+        ye = jax.lax.dot_general(
+            xe, w, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.moveaxis(
+            ye.reshape(e_groups, n, cap_pad, width), 0, 1
+        ).reshape(n * m_loc, width)
 
     def xla_path():
         a_full = (a_shard if n == 1
                   else jax.lax.all_gather(a_shard, axis, tiled=True))
+        dot = _grouped_dot if grouped else (
+            lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32))
         if silu_pair:
-            g = jnp.dot(a_full, b_gate, preferred_element_type=jnp.float32)
-            u = jnp.dot(a_full, b_up, preferred_element_type=jnp.float32)
+            g = dot(a_full, b_gate)
+            u = dot(a_full, b_up)
             c = _silu_mul_f32(g, u).astype(out_dtype)
         else:
-            h = jnp.dot(a_full, b, preferred_element_type=jnp.float32)
-            c = h.astype(out_dtype)
+            c = dot(a_full, b).astype(out_dtype)
         if arrival and n > 1:
             # honor the promised arrival layout on the fallback path:
             # block s <- global chunk (me - s) mod n (inverse of
@@ -383,7 +415,8 @@ def ag_gemm(
             t //= 2
         return max(t, 1)
 
-    tm = fit(cfg.tile_m, m_loc)
+    # grouped: the M tile subdivides one expert block (cap_pad rows)
+    tm = fit(cfg.tile_m, cap_pad)
     tk = fit(cfg.tile_k, k)
     # in silu_pair mode the C tile is the per-half width
     tn = fit(max(cfg.tile_n // 2, 128) if silu_pair else cfg.tile_n,
@@ -392,6 +425,7 @@ def ag_gemm(
     itemsize = jnp.dtype(a_shard.dtype).itemsize
     out_itemsize = jnp.dtype(out_dtype).itemsize
     mt = cdiv(m_loc, tm)
+    tiles_per_e = cap_pad // tm
     nt = cdiv(i_loc, tn)
     nk = cdiv(k, tk)
 
@@ -414,9 +448,17 @@ def ag_gemm(
 
     need_ws = n > 1 or return_gathered
     grid = (n, mt, nt, nk)
-    b_spec = pl.BlockSpec(
-        (tk, tn), lambda s, i, j, kk: (kk, j), memory_space=pltpu.VMEM,
-    )
+    if grouped:
+        b_spec = pl.BlockSpec(
+            (1, tk, tn),
+            lambda s, i, j, kk, _t=tiles_per_e: (i // _t, kk, j),
+            memory_space=pltpu.VMEM,
+        )
+    else:
+        b_spec = pl.BlockSpec(
+            (tk, tn), lambda s, i, j, kk: (kk, j),
+            memory_space=pltpu.VMEM,
+        )
     if silu_pair:
         in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec, b_spec]
         inputs = [a_shard, b_gate, b_up]
@@ -449,7 +491,7 @@ def ag_gemm(
         functools.partial(_ag_gemm_kernel, axis, n, mt, nt, nk,
                           tm, tn, tk, out_dtype,
                           (cfg.straggler_rank, cfg.straggler_ns),
-                          need_ws, cache_a, silu_pair, arrival),
+                          need_ws, cache_a, silu_pair, arrival, grouped),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),

@@ -16,16 +16,18 @@ The overlap structure maps as:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.kernels.allgather import ring_all_gather
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm
 from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
 from triton_dist_tpu.kernels.moe_utils import (
     ExpertSort,
     combine_topk,
+    pack_by_expert,
 )
 from triton_dist_tpu.kernels.reduce_scatter import (
     ReduceScatterMethod,
@@ -89,3 +91,110 @@ def ag_group_gemm_ref(x_shard, w_stack, sort, axis: str = TP_AXIS):
     """Unfused XLA reference (AG + ragged_dot)."""
     x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
     return grouped_gemm(x_full[sort.token_idx], w_stack, sort.group_sizes)
+
+
+# -- fused one-kernel MoE pair ------------------------------------------------
+#
+# TPU-native re-design of the reference's OVERLAPPED MoE pair (the ring AG
+# consumed per-segment inside the group GEMM, allgather_group_gemm.py:535,
+# and the grouped GEMM feeding the RS, moe_reduce_rs.py:167-246). The
+# ragged sorted layout the reference's consumer walks cannot feed the MXU
+# with static tiles, so the fused path reshapes the problem:
+#
+#   1. each rank packs its OWN tokens into fixed-capacity per-expert
+#      blocks (moe_utils.pack_by_expert — router weights are replicated,
+#      so local routing needs no pre-gather);
+#   2. ONE Pallas kernel ring-allgathers the packed blocks while its MXU
+#      consumer multiplies each arrived expert block against that
+#      expert's weight slice, with the silu(gate)*up epilogue fused
+#      (ag_gemm grouped mode — the dense AG+GEMM ring machinery, shared);
+#   3. the down-projection runs as an E-batched dot, the topk combine is
+#      one dense gather via the pack's inverse map, and the credit-flow
+#      ring reduce_scatter returns the sequence shards.
+#
+# Capacity overflow drops (GShard trade, counted in meta.drops);
+# capacity = m_tok * top_k is exact (zero drops possible).
+
+
+class MoEFusedMeta(NamedTuple):
+    """Origin-side combine metadata, gathered in rank order."""
+
+    slot_of: jax.Array  # (n, m_tok, k) flat slot in the source shard; -1=drop
+    weights: jax.Array  # (n, m_tok, k) f32 topk weights
+    drops: jax.Array    # () int32 — THIS rank's dropped (token, choice) rows
+
+
+def fused_ag_moe_up(
+    x_shard: jax.Array,       # (m_tok, H) this rank's tokens
+    topk_ids: jax.Array,      # (m_tok, k) expert ids (local routing)
+    topk_weights: jax.Array,  # (m_tok, k) f32
+    w_gate: jax.Array,        # (E, H, I_loc)
+    w_up: jax.Array,          # (E, H, I_loc)
+    axis: str = TP_AXIS,
+    capacity: Optional[int] = None,
+    capacity_factor: float = 2.0,
+    config=None,
+    force_kernel: bool = False,
+):
+    """Fused AG + grouped gate/up GEMM + silu. Returns
+    (act (n, E, cap, I_loc) in x.dtype — arrival-step-major source
+    blocks, meta). Per-device inside shard_map."""
+    n = jax.lax.axis_size(axis)
+    m_tok, h = x_shard.shape
+    e = w_gate.shape[0]
+    k = topk_ids.shape[1]
+    if capacity is None:
+        capacity = int(-(-m_tok * k * capacity_factor // e))
+    cap = min(max(capacity, 8), m_tok * k)
+    cap = -(-cap // 8) * 8  # sublane-aligned block heights
+    pack = pack_by_expert(x_shard, topk_ids, e, cap)
+    act = ag_gemm(
+        pack.x, (w_gate, w_up), axis=axis, config=config,
+        epilogue="silu_pair", c_order="arrival",
+        force_kernel=force_kernel, out_dtype=x_shard.dtype,
+    )
+    act = act.reshape(n, e, cap, w_gate.shape[-1])
+    meta = MoEFusedMeta(
+        slot_of=jax.lax.all_gather(pack.slot_of, axis),
+        weights=jax.lax.all_gather(topk_weights.astype(jnp.float32), axis),
+        drops=pack.drops,
+    )
+    return act, meta
+
+
+def fused_moe_down_combine_rs(
+    act: jax.Array,     # (n, E, cap, I_loc) from fused_ag_moe_up
+    w_down: jax.Array,  # (E, I_loc, H)
+    meta: MoEFusedMeta,
+    axis: str = TP_AXIS,
+    out_dtype=None,
+    method: Optional[ReduceScatterMethod] = None,
+) -> jax.Array:
+    """E-batched down-projection + gather-formulated topk combine +
+    ring ReduceScatter. Returns (m_tok, H) sequence shards."""
+    n, e, cap, i_loc = act.shape
+    h = w_down.shape[-1]
+    out_dtype = out_dtype or act.dtype
+    xe = jnp.moveaxis(act, 1, 0).reshape(e, n * cap, i_loc)
+    ye = jax.lax.dot_general(
+        xe, w_down, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # (E, n*cap, H) f32
+    y_flat = jnp.moveaxis(
+        ye.reshape(e, n, cap, h), 0, 1
+    ).reshape(n * e * cap, h)  # arrival-step-major flat slots
+
+    # combine: one dense gather via the inverse pack map. Source rank r's
+    # blocks sit at arrival step s = (me - r) mod n.
+    me = jax.lax.axis_index(axis)
+    m_tok, k = meta.slot_of.shape[1], meta.slot_of.shape[2]
+    base = (jnp.mod(me - jnp.arange(n), n) * (e * cap))[:, None, None]
+    live = meta.slot_of >= 0
+    gslot = jnp.where(live, meta.slot_of + base, 0)
+    wts = jnp.where(live, meta.weights, 0.0)
+    rows = y_flat[gslot.reshape(-1)].reshape(n, m_tok, k, h)
+    y = jnp.einsum("nmkh,nmk->nmh", rows, wts)  # (n, m_tok, H) f32
+    y = y.reshape(n * m_tok, h).astype(out_dtype)
+    if n == 1:
+        return y
+    return reduce_scatter(y, axis, method=method)

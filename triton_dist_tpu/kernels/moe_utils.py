@@ -62,6 +62,62 @@ def sort_by_expert(topk_ids: jax.Array, n_experts: int) -> ExpertSort:
     return ExpertSort(sort_idx, token_idx, group_sizes, unsort_idx)
 
 
+class ExpertPack(NamedTuple):
+    """Local tokens packed into fixed-capacity per-expert blocks — the
+    static-shape MXU formulation of the reference's sorted ragged layout
+    (ref: kernels/nvidia/allgather_group_gemm.py:85-199 sorted gather
+    index). Capacity-padded blocks trade pad FLOPs for fully static
+    tiles; overflow beyond `capacity` rows per expert is dropped (GShard
+    trade, same as kernels/ep_a2a.py — `drops` counts them)."""
+
+    x: jax.Array           # (E * cap, H) tokens grouped by expert
+    slot_of: jax.Array     # (M, k) flat slot e*cap+p per choice, -1=drop
+    counts: jax.Array      # (E,) tokens per expert (clamped to cap)
+    drops: jax.Array       # () int32 overflow rows dropped
+
+
+def pack_by_expert(
+    x: jax.Array,          # (M, H)
+    topk_ids: jax.Array,   # (M, k)
+    n_experts: int,
+    capacity: int,
+) -> ExpertPack:
+    """Gather-formulated fixed-capacity pack (one dense gather, no
+    row-scatter — see kernels/ep_a2a.py `_pack_by_dest` for why scatter
+    is serial on TPU). Slot (e, p) takes the p-th (token, choice) pair
+    routed to expert e in stable token order; `slot_of` is the inverse
+    map (also gather-built, via the double argsort), which lets the
+    combine read expert outputs back with one dense gather."""
+    m, k = topk_ids.shape
+    c = capacity
+    flat_ids = topk_ids.reshape(-1)
+    order = jnp.argsort(flat_ids, stable=True)
+    inv_order = jnp.argsort(order, stable=True)
+    seg_count = jnp.bincount(flat_ids, length=n_experts)
+    seg_start = jnp.cumsum(seg_count) - seg_count
+
+    slot_e = (jnp.arange(n_experts * c) // c).astype(jnp.int32)
+    slot_p = (jnp.arange(n_experts * c) % c).astype(jnp.int32)
+    valid = slot_p < jnp.minimum(seg_count, c)[slot_e]
+    entry = order[jnp.minimum(seg_start[slot_e] + slot_p, m * k - 1)]
+    tok = jnp.where(valid, (entry // k).astype(jnp.int32), 0)
+    xp = jnp.where(valid[:, None], x[tok], jnp.zeros((), x.dtype))
+
+    # inverse map: choice f sits at within-expert position
+    # inv_order[f] - seg_start[expert(f)]; beyond capacity -> dropped
+    p_of = inv_order - seg_start[flat_ids]
+    slot_of = jnp.where(
+        p_of < c, flat_ids * c + p_of, -1
+    ).astype(jnp.int32).reshape(m, k)
+    drops = jnp.sum(jnp.maximum(seg_count - c, 0)).astype(jnp.int32)
+    return ExpertPack(
+        x=xp,
+        slot_of=slot_of,
+        counts=jnp.minimum(seg_count, c).astype(jnp.int32),
+        drops=drops,
+    )
+
+
 def combine_topk(
     y_sorted: jax.Array,  # (M*k, H) expert outputs in sorted order
     sort: ExpertSort,

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from triton_dist_tpu.kernels.allgather_group_gemm import (
     ag_group_gemm,
     ag_group_gemm_ref,
+    fused_ag_moe_up,
+    fused_moe_down_combine_rs,
     moe_all_gather,
     moe_reduce_rs,
 )
@@ -50,11 +52,35 @@ def tp_moe_fwd(
     top_k: int,
     axis: str = TP_AXIS,
     mode: str = "dist",
+    capacity: int | None = None,
+    capacity_factor: float = 2.0,
 ):
     """TP-MoE forward (ref: tp_moe.py:237 dist fwd; :107 torch fwd for
     mode='xla'; AR analog for the replicated decode path). Sequence-sharded
-    modes return (M/n, H); 'ar' returns (M, H) replicated."""
+    modes return (M/n, H); 'ar' returns (M, H) replicated.
+
+    mode='fused' runs the one-kernel overlapped pair (ring AG consumed
+    per step by the grouped gate/up GEMM with fused silu; see
+    allgather_group_gemm.fused_ag_moe_up). Routing is LOCAL (replicated
+    router weights), packing is capacity-padded: `capacity` rows per
+    (rank, expert), default ceil(M/n*k*capacity_factor/E); capacity
+    = M/n * top_k is exact (zero drops possible)."""
     n_experts = params.w_router.shape[-1]
+    if mode == "fused":
+        logits = jnp.dot(
+            x_shard.astype(jnp.float32),
+            params.w_router.astype(jnp.float32),
+        )
+        weights, ids = topk_routing(logits, top_k)
+        i2 = params.w_gate_up.shape[-1] // 2
+        act, meta = fused_ag_moe_up(
+            x_shard, ids, weights,
+            params.w_gate_up[..., :i2], params.w_gate_up[..., i2:],
+            axis, capacity=capacity, capacity_factor=capacity_factor,
+        )
+        return fused_moe_down_combine_rs(
+            act, params.w_down, meta, axis, out_dtype=x_shard.dtype,
+        )
     # Router on the full token set. Router logits must be identical on all
     # ranks (the sort permutation must agree), so compute from the gathered
     # tokens in f32.
