@@ -1,0 +1,25 @@
+"""Focused runner: only the 32B megakernel decode chain (bisect aid)."""
+import json
+import sys
+import time
+
+import bench
+from triton_dist_tpu.runtime import make_mesh
+
+
+def main():
+    import jax
+
+    world = min(len(jax.devices()), bench.TP)  # match bench.main()
+    mesh = make_mesh(mesh_shape=(world,), axis_names=("tp",))
+    t0 = time.time()
+    ms, raw = bench.bench_mega_decode_32b(mesh)
+    print(json.dumps({
+        "mega_decode_qwen3_32b_ms": round(ms, 4),
+        "raw": raw,
+        "wall_s": round(time.time() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
