@@ -30,7 +30,9 @@ from triton_dist_tpu.kernels import (                          # noqa: E402
     ring_all_gather,
 )
 from triton_dist_tpu.perf_model import estimate_ag_gemm_ms     # noqa: E402
-from triton_dist_tpu.runtime.utils import chain_timer          # noqa: E402
+from triton_dist_tpu.runtime.utils import (                    # noqa: E402
+    chain_timer, slope_timer,
+)
 
 ON_TPU = jax.devices()[0].platform == "tpu"
 # CPU interpret mode is ~1000x slower; keep shapes tiny there
@@ -64,8 +66,12 @@ def _time(fn, a, b, a_spec=None):
             out_specs=P("tp"), check_vma=False,
         ))
 
-    ms, _ = chain_timer(build, (a, b), k_hi=K_HI,
-                        pairs=7 if ON_TPU else 2, warmup=2)
+    if ON_TPU:
+        # long-chain Theil-Sen slopes (robust to the tunnel's two-sided
+        # per-call overhead jitter; see runtime.utils.slope_timer)
+        ms, _ = slope_timer(build, (a, b), ks=(1, K_HI // 2 + 1, K_HI))
+    else:
+        ms, _ = chain_timer(build, (a, b), k_hi=K_HI, pairs=2, warmup=2)
     return ms
 
 

@@ -19,7 +19,7 @@ from triton_dist_tpu.kernels import (                          # noqa: E402
     AgGemmConfig, ag_gemm, ag_gemm_ref,
 )
 from triton_dist_tpu.runtime import make_mesh                  # noqa: E402
-from triton_dist_tpu.runtime.utils import ratio_timer          # noqa: E402
+from triton_dist_tpu.runtime.utils import slope_ratio_timer    # noqa: E402
 
 M, K, N = 2048, 5120, 6400
 
@@ -34,6 +34,9 @@ def make_build(mesh, cfg, order="arrival"):
                                 force_kernel=True, c_order=order)
                 else:
                     h = ag_gemm_ref(c, w, axis="tp")
+                # barrier: keep XLA from sinking the carry slice into
+                # its dot (see bench.bench_ag_gemm_kernel)
+                h = jax.lax.optimization_barrier(h)
                 return h[:M, :K].astype(c.dtype)
 
             out = jax.lax.fori_loop(0, k, body, x)
@@ -53,8 +56,9 @@ def main():
     w = jnp.asarray(rng.standard_normal((K, N)) * 0.02, jnp.bfloat16)
 
     # each config is measured INTERLEAVED with the XLA reference
-    # (ratio_timer): this pool's clock drifts ±8% on a seconds timescale,
-    # so sequential comparisons are meaningless.
+    # (slope_ratio_timer: long-chain medians + Theil-Sen slopes — the
+    # tunnel's per-call overhead jitters ~±30 ms two-sided, so short
+    # paired diffs are meaningless; see runtime.utils.slope_timer).
     xla_build = make_build(mesh, None)
     xla_cache = {}
 
@@ -64,6 +68,8 @@ def main():
         return xla_cache[k]
 
     sweeps = [
+        ("dbuf  tm256  tn3200 tk512", AgGemmConfig(256, 3200, 512)),
+        ("dbuf  tm512  tn3200 tk512", AgGemmConfig(512, 3200, 512)),
         ("dbuf  tm512  tn1280 tk1024", AgGemmConfig(512, 1280, 1024)),
         ("dbuf  tm1024 tn1280 tk512", AgGemmConfig(1024, 1280, 512)),
         ("dbuf  tm512  tn1280 tk512", AgGemmConfig(512, 1280, 512)),
@@ -77,8 +83,8 @@ def main():
     ]
     for label, cfg in sweeps:
         try:
-            r, pm, xm = ratio_timer(make_build(mesh, cfg), xla_memo,
-                                    (x, w), k_hi=51, pairs=5)
+            r, pm, xm = slope_ratio_timer(make_build(mesh, cfg),
+                                          xla_memo, (x, w))
             print(f"{label:28s} {pm:7.4f} ms  ratio {r:.3f} "
                   f"(xla {xm:.4f})", flush=True)
         except Exception as e:

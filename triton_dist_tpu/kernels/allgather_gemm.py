@@ -21,8 +21,11 @@ instead of once per output column tile, cutting A HBM traffic by nt x —
 and the own shard is read straight from a_ref, so the workspace copy and
 the ring forward start ride the first tiles' compute instead of blocking
 it. At the Qwen3-32B bench shape this takes total HBM traffic from
-~409 MB to ~309 MB per call and reaches 1.00-1.03x of XLA's matmul
-(benchmark/sweep_ag_gemm.py), vs 1.11x for the round-3 grid.
+~409 MB to ~309 MB per call and reaches 0.98-1.00x of XLA's matmul
+with the default (256, 3200, 512) tiles (benchmark/sweep_ag_gemm.py;
+slope-timer methodology, round 5 — the round-4 1.14x reading mixed
+short-chain measurement noise with an XLA arm whose carry slice
+narrowed its dot).
 
 epilogue="silu_pair" fuses the TP-MLP gate/up activation into the store:
 b is the fused (K, 2*I) gate|up weight, the kernel keeps one accumulator
@@ -52,6 +55,7 @@ from triton_dist_tpu.lang.core import (
     tpu_call,
     compiler_params,
     cost_estimate,
+    fit_tile,
     next_collective_id,
     cdiv,
     interpret_no_headroom,
@@ -65,14 +69,16 @@ class AgGemmConfig:
     ref: allgather_gemm.py:417-456 BLOCK_M/N/K, num_stages)."""
 
     # v5e sweep at (M=2048, K=5120, N=6400) bf16 (benchmark/
-    # sweep_ag_gemm.py + interleaved ratio_timer): what dominates at
-    # these shapes is PER-GRID-STEP overhead, not HBM traffic — tk=1024
-    # (100 steps) beats tk=512 (200 steps) by ~5% even though it streams
-    # A nt times; the arrival-order auto-pipelined store (see ag_gemm)
-    # buys the rest, landing at ~1.00x of XLA's matmul.
-    tile_m: int = 512
-    tile_n: int = 1280
-    tile_k: int = 1024
+    # sweep_ag_gemm.py + slope_timer, round-5 methodology): what
+    # dominates at these shapes is PER-GRID-STEP overhead, not HBM
+    # traffic — the near-full-width N tile (nt=2) with a small M tile
+    # beats every narrower sweep; (256, 3200, 512) measures 0.676 ms vs
+    # XLA's 0.689 (0.98x). tn is lane-constrained to multiples of 128
+    # dividing N_loc; _fit() degrades both tiles gracefully at other
+    # shapes.
+    tile_m: int = 256
+    tile_n: int = 3200
+    tile_k: int = 512
     # VMEM ceiling for the auto fallback / cache-mode decision.
     vmem_budget: int = 15 << 20
     # A-strip VMEM cache: one DMA per (i, kk) block per ring step instead
@@ -405,15 +411,7 @@ def ag_gemm(
         # variant at the bench shape, benchmark/sweep_ag_gemm.py).
         return xla_path()
 
-    def fit(tile, dim):
-        """Largest divisor of dim that is <= tile and a multiple of 128
-        when possible."""
-        t = min(tile, dim)
-        while t > 128 and dim % t:
-            t -= 128
-        while dim % t:
-            t //= 2
-        return max(t, 1)
+    fit = fit_tile  # shared tile-fitting rule (lang.core)
 
     # grouped: the M tile subdivides one expert block (cap_pad rows)
     tm = fit(cfg.tile_m, cap_pad)

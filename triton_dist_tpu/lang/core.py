@@ -89,6 +89,17 @@ def round_up(a: int, b: int) -> int:
     return cdiv(a, b) * b
 
 
+def fit_tile(tile: int, dim: int) -> int:
+    """Largest divisor of dim that is <= tile, preferring lane multiples
+    (shared tile-fitting rule of the blocked GEMM kernels)."""
+    t = min(tile, dim)
+    while t > 128 and dim % t:
+        t -= 128
+    while dim % t:
+        t //= 2
+    return max(t, 1)
+
+
 def min_tile(dtype) -> tuple:
     """Minimum (sublane, lane) tile for a dtype on TPU."""
     d = jnp.dtype(dtype)

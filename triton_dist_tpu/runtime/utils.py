@@ -136,6 +136,86 @@ def ratio_timer(build_a, build_b, args, k_lo=1, k_hi=51, pairs=7,
             float(np.median(db_all)))
 
 
+def slope_timer(build_fn, args, ks=(1, 201, 401), rounds=6, warmup=2):
+    """Per-iteration time via a robust slope fit over chain lengths.
+
+    Why not paired diffs at small k: the tunnel's fixed per-call overhead
+    is ~70-125 ms and jitters BOTH ways (a 76.9 ms k=51 sample was
+    measured below the 108 ms k=1 baseline), so a 16 ms chain signal
+    drowns. The answer is signal amplification — chains long enough
+    (ks up to ~400 iterations for sub-ms kernels) that the per-k spread
+    is small relative to the span — plus a median per chain length (the
+    jitter is two-sided, so min would chase deflated samples) and a
+    Theil-Sen slope (median of pairwise slopes) across chain lengths,
+    which tolerates one fully-contaminated k. Costs one compile per
+    chain length — use for small kernels, not model-scale programs."""
+    fns = {k: build_fn(k) for k in ks}
+    for f in fns.values():
+        np.asarray(f(*args))  # compile
+
+    def once(f):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))
+        return (time.perf_counter() - t0) * 1e3
+
+    for _ in range(warmup):
+        for f in fns.values():
+            once(f)
+    t_med = {
+        k: float(np.median([once(fns[k]) for _ in range(rounds)]))
+        for k in ks
+    }
+    slopes = [
+        (t_med[k2] - t_med[k1]) / (k2 - k1)
+        for i, k1 in enumerate(ks) for k2 in ks[i + 1:]
+    ]
+    ms = float(np.median(slopes))
+    if ms <= 0:
+        raise RuntimeError(f"measurement failed: median slope {ms} <= 0")
+    return ms, {"t_med_ms": {k: round(v, 4) for k, v in t_med.items()},
+                "slopes": [round(s, 4) for s in slopes]}
+
+
+def slope_ratio_timer(build_a, build_b, args, ks=(1, 201, 401), rounds=6,
+                      warmup=2):
+    """Ratio of two kernels' per-iteration slopes, rounds interleaved
+    across both arms so a clock-drift window hits them alike. Returns
+    (ratio, a_ms, b_ms). See slope_timer for the robustness argument."""
+    fa = {k: build_a(k) for k in ks}
+    fb = {k: build_b(k) for k in ks}
+    for f in list(fa.values()) + list(fb.values()):
+        np.asarray(f(*args))  # compile
+
+    def once(f):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))
+        return (time.perf_counter() - t0) * 1e3
+
+    for _ in range(warmup):
+        for k in ks:
+            once(fa[k]), once(fb[k])
+    ta = {k: [] for k in ks}
+    tb = {k: [] for k in ks}
+    for _ in range(rounds):
+        for k in ks:
+            ta[k].append(once(fa[k]))
+            tb[k].append(once(fb[k]))
+
+    def slope(t):
+        t_med = {k: float(np.median(v)) for k, v in t.items()}
+        s = [
+            (t_med[k2] - t_med[k1]) / (k2 - k1)
+            for i, k1 in enumerate(ks) for k2 in ks[i + 1:]
+        ]
+        return float(np.median(s))
+
+    a_ms, b_ms = slope(ta), slope(tb)
+    if a_ms <= 0 or b_ms <= 0:
+        raise RuntimeError(
+            f"measurement failed: slopes {a_ms}, {b_ms} not positive")
+    return a_ms / b_ms, a_ms, b_ms
+
+
 def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose=True):
     """allclose with mismatch dump (ref: utils.py:870-899)."""
     x = np.asarray(x)
