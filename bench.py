@@ -405,6 +405,58 @@ def bench_gemm_rs_kernel(mesh):
     return slope_ratio_timer(build(True), build(False), (a, b))
 
 
+def bench_sp_decode_partial(mesh):
+    """The SP flash-decode local partial at long context (T=65536, the
+    full-head Qwen3-8B geometry Hq=32/Hkv=8/D=128, bf16 KV = 268 MB):
+    chunked Pallas streaming kernel vs the XLA einsum partial. The
+    partial is rank-local, so world=1 measures the real thing; the
+    (acc,lse) exchange protocol is exercised by the dryrun.
+
+    Why T=64k and not 8k: in a timing chain the KV is loop-invariant, so
+    at 8k XLA parks all 33 MB in VMEM across iterations and both arms
+    measure a VMEM-resident fantasy (~9 and ~19 us for a 41 us HBM
+    stream) that no real decode step — fresh dispatch, mutated cache —
+    ever sees. 268 MB cannot be parked, so the 64k numbers are honest
+    HBM-bound latencies (measured 350 vs 343 us, 1.02x, vs the 327 us
+    stream floor). Returns (ratio, pallas_us, xla_us)."""
+    from triton_dist_tpu.kernels.flash_decode import (
+        flash_decode_partial,
+        flash_decode_partial_pallas,
+    )
+    from triton_dist_tpu.runtime.utils import slope_ratio_timer
+
+    B, T, HQ, HKV, D = 1, 65536, 32, 8, 128
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, HQ, D)) * 0.1, jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, T, HKV, D)) * 0.1,
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, T, HKV, D)) * 0.1,
+                    jnp.bfloat16)
+    valid = jnp.asarray([T - 7], jnp.int32)
+
+    def build(impl):
+        def bld(kk):
+            def fn(q, k, v):
+                def body(_, c):
+                    o, lse = impl(c, k, v, valid)
+                    o = jax.lax.optimization_barrier(o)
+                    return o.astype(c.dtype)
+
+                out = jax.lax.fori_loop(0, kk, body, q)
+                return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+            return jax.jit(fn)
+
+        return bld
+
+    # ~500-iteration chains: signal >> the tunnel's ±30 ms per-call
+    # jitter (see slope_timer)
+    r, pm, xm = slope_ratio_timer(
+        build(flash_decode_partial_pallas), build(flash_decode_partial),
+        (q, k, v), ks=(1, 251, 501))
+    return r, pm * 1e3, xm * 1e3
+
+
 def main():
     n = len(jax.devices())
     world = min(n, TP)
@@ -479,6 +531,13 @@ def main():
         result["gemm_rs_vs_xla"] = round(rs_ratio, 4)
     except Exception as e:
         result["gemm_rs_error"] = str(e)[:200]
+    try:
+        fd_ratio, fd_us, fd_xla_us = bench_sp_decode_partial(mesh)
+        result["sp_decode_partial_t64k_us"] = round(fd_us, 2)
+        result["sp_decode_partial_xla_us"] = round(fd_xla_us, 2)
+        result["sp_decode_partial_vs_xla"] = round(fd_ratio, 4)
+    except Exception as e:
+        result["sp_decode_partial_error"] = str(e)[:200]
     try:
         result["a2a_dispatch_us"] = round(bench_a2a_dispatch(mesh), 2)
     except Exception as e:

@@ -219,3 +219,122 @@ def test_sp_decode_layer_steps_across_shard_boundary(mesh8):
             np.asarray(y[i]), ref_y, rtol=2e-3, atol=2e-3,
             err_msg=f"step {i}",
         )
+
+
+def test_flash_decode_partial_pallas_matches_xla():
+    """The chunked Pallas local partial == the XLA partial, with several
+    KV pages and ragged valid lengths (incl. a fully-empty shard)."""
+    from triton_dist_tpu.kernels.flash_decode import (
+        flash_decode_partial_pallas,
+    )
+
+    rng = np.random.default_rng(7)
+    b, t, hq, hkv, d = 3, 64, 4, 2, 128
+    q = _rand(rng, (b, hq, d))
+    k = _rand(rng, (b, t, hkv, d))
+    v = _rand(rng, (b, t, hkv, d))
+    valid = jnp.asarray([37, 0, 64])  # mid-page, empty, full
+    o_ref, lse_ref = jax.jit(flash_decode_partial)(q, k, v, valid)
+    o, lse = jax.jit(
+        functools.partial(flash_decode_partial_pallas, chunk=16)
+    )(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_flash_decode_ll_exchange_matches(mesh8):
+    """The LL-allgather partial exchange == the XLA all_gather path,
+    across several steps on one persistent context (parity reuse)."""
+    from triton_dist_tpu.kernels.flash_decode import create_sp_decode_buf
+
+    assert len(jax.devices()) > SP, "need spare virtual devices"
+    rng = np.random.default_rng(8)
+    b, t, hq, hkv, d = 2, 64, 4, 2, 16
+    kv_len = jnp.asarray([37, 64])
+    q = _rand(rng, (3, b, hq, d))
+    k = _rand(rng, (b, t, hkv, d))
+    v = _rand(rng, (b, t, hkv, d))
+
+    def dist(qs, ks, vs):
+        buf = create_sp_decode_buf(b, hq, d, SP)
+        outs = []
+        for i in range(3):
+            y, buf = sp_flash_decode(qs[i], ks, vs, kv_len, axis="tp",
+                                     ll_buf=buf, call_count=i)
+            outs.append(y)
+        return jnp.stack(outs)
+
+    def dist_ref(qs, ks, vs):
+        return jnp.stack([
+            sp_flash_decode(qs[i], ks, vs, kv_len, axis="tp")
+            for i in range(3)
+        ])
+
+    got, want = [
+        jax.jit(
+            jax.shard_map(
+                f, mesh=mesh8,
+                in_specs=(P(), P(None, "tp"), P(None, "tp")),
+                out_specs=P(), check_vma=False,
+            )
+        )(q, k, v)
+        for f in (dist, dist_ref)
+    ]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_decode_layer_ll_context_threading(mesh8):
+    """The SP decode layer with a threaded LL context matches the layer
+    without one, across steps that cross shard ownership."""
+    from triton_dist_tpu.kernels.flash_decode import create_sp_decode_buf
+
+    assert len(jax.devices()) > SP, "need spare virtual devices"
+    rng = np.random.default_rng(9)
+    b, h = 2, 64
+    hq, hkv, d = 4, 2, 16
+    t_max = 16
+    spec = SpDecodeSpec(hq, hkv, d)
+    cos, sin = rope_table(d, t_max)
+    params = SpDecodeParams(
+        w_qkv=_rand(rng, (h, (hq + 2 * hkv) * d), scale=0.1),
+        w_o=_rand(rng, ((hq * d), h), scale=0.1),
+    )
+    steps = 4
+    xs = _rand(rng, (steps, b, h), scale=0.1)
+
+    def dist(use_ll, xs_all, kc, vc):
+        outs = []
+        cache = (kc, vc)
+        buf = create_sp_decode_buf(b, hq, d, SP) if use_ll else None
+        for i in range(steps):
+            if use_ll:
+                y, cache, buf = sp_decode_attn_fwd(
+                    xs_all[i], params, spec, cos, sin, cache,
+                    jnp.full((b,), i), axis="tp", ll_buf=buf,
+                    call_count=i,
+                )
+            else:
+                y, cache = sp_decode_attn_fwd(
+                    xs_all[i], params, spec, cos, sin, cache,
+                    jnp.full((b,), i), axis="tp",
+                )
+            outs.append(y)
+        return jnp.stack(outs)
+
+    kc0 = jnp.zeros((b, t_max, hkv, d), jnp.float32)
+    vc0 = jnp.zeros_like(kc0)
+    got, want = [
+        jax.jit(
+            jax.shard_map(
+                functools.partial(dist, use_ll), mesh=mesh8,
+                in_specs=(P(), P(None, "tp"), P(None, "tp")),
+                out_specs=P(), check_vma=False,
+            )
+        )(xs, kc0, vc0)
+        for use_ll in (True, False)
+    ]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -309,9 +309,9 @@ def gemm_rs(
     if m % n:
         raise ValueError(f"M={m} not divisible by axis size {n}")
     m_loc = m // n
-    tm = min(cfg.tile_m, m_loc)
-    if m_loc % tm:
-        raise ValueError(f"chunk rows {m_loc} must divide tile_m {tm}")
+    # degrade to a dividing tile rather than raising: only the resident
+    # regime tiles A by tm (streamed/local_mm never use it)
+    tm = fit_tile(cfg.tile_m, m_loc)
     in_itemsize = jnp.dtype(a.dtype).itemsize
     out_itemsize = jnp.dtype(out_dtype).itemsize
     # Ring residents shared by both regimes: acc 2x(m_loc, N) + stage.

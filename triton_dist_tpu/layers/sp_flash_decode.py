@@ -59,8 +59,15 @@ def sp_decode_attn_fwd(
     kv_cache: Tuple[jax.Array, jax.Array],  # per-rank (B,T_loc,Hkv,D) x2
     kv_len: jax.Array,  # (B,) global length BEFORE this token
     axis: str = SP_AXIS,
+    ll_buf=None,
+    call_count=0,
+    partial_impl: str = "auto",
 ):
-    """One decode step. Returns (out (B, H) replicated, new (k, v) cache).
+    """One decode step. Returns (out (B, H) replicated, new (k, v) cache)
+    — plus the new LL-AG context when `ll_buf` is given (the layer-held
+    FastAllGatherContext of the reference, sp_flash_decode_layer.py:
+    113-146; create with kernels.flash_decode.create_sp_decode_buf and
+    thread through steps with an incrementing call_count).
     (ref fwd: sp_flash_decode_layer.py:78-146)."""
     b, h = x.shape
     hq, hkv, d = spec.num_q_heads, spec.num_kv_heads, spec.head_dim
@@ -82,11 +89,15 @@ def sp_decode_attn_fwd(
     k_cache = sp_cache_write(k_cache, k[:, 0], kv_len, axis)
     v_cache = sp_cache_write(v_cache, v[:, 0], kv_len, axis)
 
-    out = sp_flash_decode(
-        q[:, 0], k_cache, v_cache, kv_len + 1, axis
-    )  # (B, Hq, D)
+    res = sp_flash_decode(
+        q[:, 0], k_cache, v_cache, kv_len + 1, axis,
+        ll_buf=ll_buf, call_count=call_count, partial_impl=partial_impl,
+    )  # (B, Hq, D) [+ new LL context]
+    out, new_buf = res if ll_buf is not None else (res, None)
     y = jnp.dot(
         out.reshape(b, hq * d).astype(x.dtype), params.w_o,
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+    if ll_buf is not None:
+        return y, (k_cache, v_cache), new_buf
     return y, (k_cache, v_cache)

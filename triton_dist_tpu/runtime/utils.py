@@ -136,6 +136,22 @@ def ratio_timer(build_a, build_b, args, k_lo=1, k_hi=51, pairs=7,
             float(np.median(db_all)))
 
 
+def _once_ms(f, args):
+    t0 = time.perf_counter()
+    np.asarray(f(*args))  # host fetch forces completion
+    return (time.perf_counter() - t0) * 1e3
+
+
+def _theil_sen(t_by_k: dict) -> float:
+    """Median of pairwise slopes over {chain length: median time}."""
+    ks = sorted(t_by_k)
+    slopes = [
+        (t_by_k[k2] - t_by_k[k1]) / (k2 - k1)
+        for i, k1 in enumerate(ks) for k2 in ks[i + 1:]
+    ]
+    return float(np.median(slopes))
+
+
 def slope_timer(build_fn, args, ks=(1, 201, 401), rounds=6, warmup=2):
     """Per-iteration time via a robust slope fit over chain lengths.
 
@@ -152,28 +168,18 @@ def slope_timer(build_fn, args, ks=(1, 201, 401), rounds=6, warmup=2):
     fns = {k: build_fn(k) for k in ks}
     for f in fns.values():
         np.asarray(f(*args))  # compile
-
-    def once(f):
-        t0 = time.perf_counter()
-        np.asarray(f(*args))
-        return (time.perf_counter() - t0) * 1e3
-
     for _ in range(warmup):
         for f in fns.values():
-            once(f)
+            _once_ms(f, args)
     t_med = {
-        k: float(np.median([once(fns[k]) for _ in range(rounds)]))
+        k: float(np.median([_once_ms(fns[k], args)
+                            for _ in range(rounds)]))
         for k in ks
     }
-    slopes = [
-        (t_med[k2] - t_med[k1]) / (k2 - k1)
-        for i, k1 in enumerate(ks) for k2 in ks[i + 1:]
-    ]
-    ms = float(np.median(slopes))
+    ms = _theil_sen(t_med)
     if ms <= 0:
         raise RuntimeError(f"measurement failed: median slope {ms} <= 0")
-    return ms, {"t_med_ms": {k: round(v, 4) for k, v in t_med.items()},
-                "slopes": [round(s, 4) for s in slopes]}
+    return ms, {"t_med_ms": {k: round(v, 4) for k, v in t_med.items()}}
 
 
 def slope_ratio_timer(build_a, build_b, args, ks=(1, 201, 401), rounds=6,
@@ -185,29 +191,18 @@ def slope_ratio_timer(build_a, build_b, args, ks=(1, 201, 401), rounds=6,
     fb = {k: build_b(k) for k in ks}
     for f in list(fa.values()) + list(fb.values()):
         np.asarray(f(*args))  # compile
-
-    def once(f):
-        t0 = time.perf_counter()
-        np.asarray(f(*args))
-        return (time.perf_counter() - t0) * 1e3
-
     for _ in range(warmup):
         for k in ks:
-            once(fa[k]), once(fb[k])
+            _once_ms(fa[k], args), _once_ms(fb[k], args)
     ta = {k: [] for k in ks}
     tb = {k: [] for k in ks}
     for _ in range(rounds):
         for k in ks:
-            ta[k].append(once(fa[k]))
-            tb[k].append(once(fb[k]))
+            ta[k].append(_once_ms(fa[k], args))
+            tb[k].append(_once_ms(fb[k], args))
 
     def slope(t):
-        t_med = {k: float(np.median(v)) for k, v in t.items()}
-        s = [
-            (t_med[k2] - t_med[k1]) / (k2 - k1)
-            for i, k1 in enumerate(ks) for k2 in ks[i + 1:]
-        ]
-        return float(np.median(s))
+        return _theil_sen({k: float(np.median(v)) for k, v in t.items()})
 
     a_ms, b_ms = slope(ta), slope(tb)
     if a_ms <= 0 or b_ms <= 0:
