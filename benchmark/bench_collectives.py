@@ -80,6 +80,22 @@ def main():
             ("reduce_scatter", "ring",
              lambda s: ring_reduce_scatter(s, "tp"),
              estimate_rs_ms(nbytes, n)),
+            # f32-wire variant: psum-grade accumulation at 2x hop bytes
+            # (input stays f32 here, so the column isolates the knob's
+            # protocol cost; with bf16 inputs the wire doubles too)
+            ("reduce_scatter", "ring_f32wire",
+             lambda s: ring_reduce_scatter(
+                 s, "tp", accum_dtype=jnp.float32),
+             estimate_rs_ms(nbytes, n)),
+            ("reduce_scatter", "ring_bf16",
+             lambda s: ring_reduce_scatter(
+                 s.astype(jnp.bfloat16), "tp").astype(s.dtype),
+             estimate_rs_ms(nbytes // 2, n)),
+            ("reduce_scatter", "ring_bf16_f32wire",
+             lambda s: ring_reduce_scatter(
+                 s.astype(jnp.bfloat16), "tp",
+                 accum_dtype=jnp.float32).astype(s.dtype),
+             estimate_rs_ms(nbytes, n)),
             ("allreduce", "one_shot",
              lambda s: all_reduce(s, "tp",
                                   method=AllReduceMethod.OneShot),

@@ -363,3 +363,77 @@ def test_pp_schedule_runs_all_stages(mesh8):
         )
     )(x)
     np.testing.assert_allclose(np.asarray(y), 1.0 + 36.0)
+
+
+def test_blockwise_prefill_matches_dense():
+    """gqa_attention_blockwise == the dense einsum path, causal + ragged
+    kv_len, at a size where both run (round-4 verdict missing #1)."""
+    from triton_dist_tpu.layers import gqa_attention, gqa_attention_blockwise
+
+    rng = np.random.default_rng(11)
+    b, s, t, hq, hkv, d = 2, 64, 1024, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5, jnp.float32)
+    kv_len = jnp.asarray([700, 1024])
+    qpos = jnp.tile(jnp.arange(s)[None] + 600, (b, 1))
+    dense = jax.jit(functools.partial(
+        gqa_attention, causal=True))(q, k, v, q_positions=qpos,
+                                     kv_len=kv_len)
+    block = jax.jit(functools.partial(
+        gqa_attention_blockwise, causal=True, chunk=128))(
+            q, k, v, q_positions=qpos, kv_len=kv_len)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_blockwise_prefill_ctx8k_auto():
+    """ctx=8192 prefill-into-cache: gqa_attention auto-takes the
+    blockwise path (no S x T logits materialized) and matches an inline
+    dense oracle computed on a narrow q block."""
+    from triton_dist_tpu.layers import gqa_attention
+
+    rng = np.random.default_rng(12)
+    b, s, t, hq, hkv, d = 1, 128, 8192, 2, 1, 16
+    g = hq // hkv
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5, jnp.float32)
+    qpos = jnp.tile(jnp.arange(s)[None] + (t - s), (b, 1))
+    got = jax.jit(functools.partial(gqa_attention, causal=True))(
+        q, k, v, q_positions=qpos)
+
+    # inline oracle (f64, dense over the narrow q block only)
+    qf = np.asarray(q, np.float64).reshape(b, s, hkv, g, d) * d ** -0.5
+    kf = np.asarray(k, np.float64)
+    vf = np.asarray(v, np.float64)
+    lg = np.einsum("bskgd,btkd->bkgst", qf, kf)
+    mask = np.arange(t)[None, :] <= np.asarray(qpos)[0][:, None]
+    lg = np.where(mask[None, None, None], lg, -1e30)
+    p = np.exp(lg - lg.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bkgst,btkd->bskgd", p, vf).reshape(b, s, hq, d)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_blockwise_prefill_ragged_t():
+    """T not a multiple of the chunk (incl. odd): KV is padded and
+    tail-masked, not chunk-degraded (round-5 review)."""
+    from triton_dist_tpu.layers import gqa_attention, gqa_attention_blockwise
+
+    rng = np.random.default_rng(14)
+    for t in (555, 1023):
+        b, s, hq, hkv, d = 2, 16, 4, 2, 16
+        q = jnp.asarray(rng.standard_normal((b, s, hq, d)) * 0.5,
+                        jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, t, hkv, d)) * 0.5,
+                        jnp.float32)
+        qpos = jnp.tile(jnp.arange(s)[None] + (t - s), (b, 1))
+        dense = gqa_attention(q, k, v, causal=True, q_positions=qpos)
+        block = gqa_attention_blockwise(q, k, v, causal=True,
+                                        q_positions=qpos, chunk=128)
+        np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"T={t}")

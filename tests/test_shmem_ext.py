@@ -4,6 +4,7 @@ test_nvshmem_api.py per-primitive coverage, test_team_split.py,
 test_fast_allgather.py)."""
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -50,7 +51,8 @@ def _run(kernel_body, x, mesh, axis="tp", n_sems=3, out_shape=None):
 
 
 def test_getmem_shift():
-    """get from right neighbor == ring shift left."""
+    """get from right neighbor == ring shift left (shift inference is
+    opt-in via TDT_INFER_GETMEM after the round-5 strict-default flip)."""
     mesh = _mesh()
 
     def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
@@ -60,9 +62,30 @@ def test_getmem_shift():
         shmem.getmem(o_ref, x_ref, s1, s2, src, axis)
 
     x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
-    out = _run(kernel, x, mesh)
+    os.environ["TDT_INFER_GETMEM"] = "1"
+    try:
+        out = _run(kernel, x, mesh)
+    finally:
+        del os.environ["TDT_INFER_GETMEM"]
     expect = np.roll(np.asarray(x).reshape(N, 8, 128), -1, axis=0)
     np.testing.assert_allclose(np.asarray(out).reshape(N, 8, 128), expect)
+
+
+def test_getmem_strict_default_raises():
+    """Omitting reader_pe without the opt-in env is a trace-time error
+    (round-4 verdict weak #6: the silent-corruption default is gone)."""
+    mesh = _mesh()
+
+    def kernel(axis, n, x_ref, o_ref, s1, s2, s3):
+        shmem.barrier_all(axis)
+        me = shmem.my_pe(axis)
+        src = jax.lax.rem(me + 1, n)
+        shmem.getmem(o_ref, x_ref, s1, s2, src, axis)
+
+    x = jnp.arange(N * 8 * 128, dtype=jnp.float32).reshape(N * 8, 128)
+    os.environ.pop("TDT_INFER_GETMEM", None)
+    with pytest.raises(Exception, match="reader_pe"):
+        _run(kernel, x, mesh)
 
 
 def test_getmem_explicit_inverse():

@@ -513,12 +513,15 @@ def ag_gemm(
             ),
             vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
         ),
-        # launch_metadata analog (ref allgather_gemm.py:145-155)
+        # launch_metadata analog (ref allgather_gemm.py:145-155).
+        # flops: per-row work is 2*k*n_loc in BOTH modes (grouped rows
+        # multiply only their own expert's slice, and n_loc is the
+        # per-expert width there); the B stack bytes scale with E.
         cost_estimate=cost_estimate(
             flops=2 * n * m_loc * k * n_loc,
             # C is (n*m_loc, i_loc): half of n_loc in silu_pair mode
-            bytes_accessed=(n * m_loc * k + k * n_loc) * itemsize
-            + n * m_loc * i_loc * out_itemsize,
+            bytes_accessed=(n * m_loc * k + e_groups * k * n_loc)
+            * itemsize + n * m_loc * i_loc * out_itemsize,
             remote_bytes=(n - 1) * m_loc * k * itemsize,
         ),
     )(*inputs)

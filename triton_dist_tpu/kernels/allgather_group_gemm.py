@@ -132,21 +132,33 @@ def fused_ag_moe_up(
     w_up: jax.Array,          # (E, H, I_loc)
     axis: str = TP_AXIS,
     capacity: Optional[int] = None,
-    capacity_factor: float = 2.0,
+    capacity_factor: Optional[float] = None,
     config=None,
     force_kernel: bool = False,
 ):
     """Fused AG + grouped gate/up GEMM + silu. Returns
     (act (n, E, cap, I_loc) in x.dtype — arrival-step-major source
-    blocks, meta). Per-device inside shard_map."""
+    blocks, meta). Per-device inside shard_map.
+
+    Capacity: the DEFAULT (neither capacity nor capacity_factor given)
+    is the exact m_tok*top_k — zero drops possible, matching every
+    other mode's lossless semantics (round-4 ADVICE). A smaller
+    capacity / a capacity_factor opts into the GShard drop trade;
+    meta.drops counts this rank's dropped (token, choice) rows."""
+    from triton_dist_tpu.lang.core import min_tile, round_up
+
     n = jax.lax.axis_size(axis)
     m_tok, h = x_shard.shape
     e = w_gate.shape[0]
     k = topk_ids.shape[1]
     if capacity is None:
-        capacity = int(-(-m_tok * k * capacity_factor // e))
-    cap = min(max(capacity, 8), m_tok * k)
-    cap = -(-cap // 8) * 8  # sublane-aligned block heights
+        capacity = (m_tok * k if capacity_factor is None
+                    else int(-(-m_tok * k * capacity_factor // e)))
+    # block heights tile-aligned for the grouped ring kernel's A-row DMA
+    # offsets (sublane tile is dtype-dependent: 16 for bf16 — round-4
+    # ADVICE: a hard-coded 8 produced Mosaic-rejected offsets)
+    st = min_tile(x_shard.dtype)[0]
+    cap = round_up(min(max(capacity, 1), m_tok * k), st)
     pack = pack_by_expert(x_shard, topk_ids, e, cap)
     act = ag_gemm(
         pack.x, (w_gate, w_up), axis=axis, config=config,

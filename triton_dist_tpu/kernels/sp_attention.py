@@ -33,15 +33,21 @@ from triton_dist_tpu.runtime.init import SP_AXIS
 NEG_INF = -1e30
 
 
-def _block_update(q, k, v, q_pos, k_pos, acc, m, l, scale, causal):
+def _block_update(q, k, v, q_pos, k_pos, acc, m, l, scale, causal,
+                  kv_len=None):
     """Fold one KV block into the online-softmax state (f32).
 
     q: (B, Sq, Hkv, G, D); k/v: (B, Skv, Hkv, D);
-    acc: (B, Hkv, G, Sq, D); m, l: (B, Hkv, G, Sq, 1)."""
+    acc: (B, Hkv, G, Sq, D); m, l: (B, Hkv, G, Sq, 1).
+    kv_len: optional (B,) per-sequence valid KV length (varlen batches:
+    rows at k_pos >= kv_len[b] are masked for that sequence only)."""
     s = jnp.einsum("bskgd,btkd->bkgst", q, k) * scale
     if causal:
         mask = k_pos[None, None, None, None, :] <= q_pos[:, None, None, :, None]
         s = jnp.where(mask, s, NEG_INF)
+    if kv_len is not None:
+        valid = k_pos[None, :] < jnp.reshape(kv_len, (-1, 1))  # (B, Skv)
+        s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
     m_blk = jnp.max(s, axis=-1, keepdims=True)  # (B,Hkv,G,Sq,1)
     m_new = jnp.maximum(m, m_blk)
     # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1
@@ -62,12 +68,20 @@ def ring_attention(
     axis: str = SP_AXIS,
     causal: bool = True,
     scale: Optional[float] = None,
+    kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel GQA attention; per-device inside shard_map.
 
     Returns (B, Sq_loc, Hq, D) — each rank's query block attended over the
     FULL (sharded) sequence (ref consumer contract:
-    sp_ag_attention_intra_node.py:256-427)."""
+    sp_ag_attention_intra_node.py:256-427).
+
+    kv_len: optional (B,) per-sequence GLOBAL valid length — the varlen /
+    ragged-batch form (the reference's cu_seqlens path,
+    sp_ag_attention_intra_node.py:256-427): sequence b attends only KV
+    positions < kv_len[b]. Query rows at positions >= kv_len[b] are
+    padding; they still attend the valid prefix (the causal mask keeps
+    the past open) — callers ignore those rows."""
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     b, sq, hq, d = q.shape
@@ -87,6 +101,7 @@ def ring_attention(
         acc, m, l = _block_update(
             qf, k.astype(jnp.float32), v.astype(jnp.float32),
             q_pos, jnp.arange(skv), acc0, m0, l0, scale, causal,
+            kv_len=kv_len,
         )
     else:
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -99,7 +114,7 @@ def ring_attention(
             k_pos = chunk * skv + jnp.arange(skv)
             acc, m, l = _block_update(
                 qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
-                q_pos, k_pos, acc, m, l, scale, causal,
+                q_pos, k_pos, acc, m, l, scale, causal, kv_len=kv_len,
             )
             if s < n - 1:
                 # rotate the KV block to the right neighbor (the
@@ -114,7 +129,8 @@ def ring_attention(
 
 
 def ring_attention_ref(q, k, v, axis: str = SP_AXIS, causal: bool = True,
-                       scale: Optional[float] = None):
+                       scale: Optional[float] = None,
+                       kv_len: Optional[jax.Array] = None):
     """Unfused oracle: gather the full KV and run plain GQA attention."""
     from triton_dist_tpu.layers.attention import gqa_attention
 
@@ -125,5 +141,6 @@ def ring_attention_ref(q, k, v, axis: str = SP_AXIS, causal: bool = True,
     v_full = jax.lax.all_gather(v, axis, axis=1, tiled=True)
     q_pos = me * sq + jnp.tile(jnp.arange(sq)[None], (q.shape[0], 1))
     return gqa_attention(
-        q, k_full, v_full, causal=causal, q_positions=q_pos, scale=scale
+        q, k_full, v_full, causal=causal, q_positions=q_pos, scale=scale,
+        kv_len=kv_len,
     )

@@ -265,7 +265,7 @@ def sync_all(axis: AxisName) -> None:
     barrier_all(axis)
 
 
-def straggler_delay(axis: AxisName, rank, nanos: int) -> None:
+def straggler_delay(axis: AxisName, rank, nanos: int, sem=None) -> None:
     """Race-provocation hook: stall one team member inside the kernel
     (ref: the `straggler_option` per-rank torch.cuda._sleep injection,
     allgather_gemm.py:602-603 / allreduce.py:137-142, and the
@@ -276,10 +276,17 @@ def straggler_delay(axis: AxisName, rank, nanos: int) -> None:
 
     Native TPU uses pl.delay (cycle-accurate). pl.delay is a NO-OP in
     interpret mode, so on the CPU mesh the stall is a loop of effectful
-    self-signal/wait pairs on the barrier semaphore — each iteration is
-    real interpreter wall time on the delayed rank's executor thread,
-    which is what actually skews rank progress there (nanos maps to
-    iterations loosely; provocation needs skew, not precision)."""
+    self-signal/wait pairs on a semaphore — each iteration is real
+    interpreter wall time on the delayed rank's executor thread, which
+    is what actually skews rank progress there (nanos maps to iterations
+    loosely; provocation needs skew, not precision).
+
+    `sem`: the churn semaphore (defaults to the collective barrier
+    semaphore). CAUTION — the semaphore churn is single-core-only: in a
+    multi-core interpret kernel the unqualified signal and the wait can
+    land on different cores' semaphore instances and deadlock; such
+    kernels must implement their own delay from per-core primitives
+    (e.g. a local-DMA churn — see the megakernel AR branch)."""
     if nanos <= 0:
         return
     from triton_dist_tpu.lang.core import use_interpret
@@ -289,11 +296,11 @@ def straggler_delay(axis: AxisName, rank, nanos: int) -> None:
     @pl.when(me == rank)
     def _():
         if use_interpret():
-            bsem = pltpu.get_barrier_semaphore()
+            csem = pltpu.get_barrier_semaphore() if sem is None else sem
 
             def churn(_, carry):
-                pltpu.semaphore_signal(bsem, inc=1)
-                pltpu.semaphore_wait(bsem, 1)
+                pltpu.semaphore_signal(csem, inc=1)
+                pltpu.semaphore_wait(csem, 1)
                 return carry
 
             jax.lax.fori_loop(0, max(1, nanos // 5000), churn, 0)
@@ -325,13 +332,16 @@ def getmem_nbi(
     # permutation the inferred inverse targets the wrong rank and the
     # failure is a silent corruption or hang — and shift-uniformity is
     # not locally checkable (it is a property of from_pe across ranks).
-    # TDT_STRICT_GETMEM=1 turns omission into a trace-time error for
-    # code that cannot guarantee shift patterns.
-    if reader_pe is None and os.environ.get("TDT_STRICT_GETMEM") == "1":
+    # STRICT BY DEFAULT (round-4 verdict weak #6): omitting reader_pe is
+    # a trace-time error; TDT_INFER_GETMEM=1 opts back into shift
+    # inference for code that guarantees uniform-shift patterns.
+    if reader_pe is None and os.environ.get("TDT_INFER_GETMEM") != "1":
         raise ValueError(
-            "getmem_nbi: reader_pe not given and TDT_STRICT_GETMEM=1 — "
-            "the default inference is only correct for uniform ring "
-            "shifts; pass reader_pe (the inverse permutation) explicitly"
+            "getmem_nbi: reader_pe not given — the shift inference is "
+            "only correct for uniform ring shifts and fails SILENTLY "
+            "otherwise; pass reader_pe (the inverse permutation) "
+            "explicitly, or set TDT_INFER_GETMEM=1 to accept inference "
+            "for guaranteed-shift patterns"
         )
     me = my_pe(axis)
     n = n_pes(axis)

@@ -10,7 +10,10 @@ modules; each carries the same three-mode switch (xla / dist / ar).
 
 from triton_dist_tpu.layers.norm import rms_norm  # noqa: F401
 from triton_dist_tpu.layers.rope import rope_table, apply_rope  # noqa: F401
-from triton_dist_tpu.layers.attention import gqa_attention  # noqa: F401
+from triton_dist_tpu.layers.attention import (  # noqa: F401
+    gqa_attention,
+    gqa_attention_blockwise,
+)
 from triton_dist_tpu.layers.tp_mlp import (  # noqa: F401
     TPMLPParams,
     tp_mlp_fwd,

@@ -53,7 +53,9 @@ def tp_moe_fwd(
     axis: str = TP_AXIS,
     mode: str = "dist",
     capacity: int | None = None,
-    capacity_factor: float = 2.0,
+    capacity_factor: float | None = None,
+    force_kernel: bool = False,
+    return_drops: bool = False,
 ):
     """TP-MoE forward (ref: tp_moe.py:237 dist fwd; :107 torch fwd for
     mode='xla'; AR analog for the replicated decode path). Sequence-sharded
@@ -63,8 +65,11 @@ def tp_moe_fwd(
     per step by the grouped gate/up GEMM with fused silu; see
     allgather_group_gemm.fused_ag_moe_up). Routing is LOCAL (replicated
     router weights), packing is capacity-padded: `capacity` rows per
-    (rank, expert), default ceil(M/n*k*capacity_factor/E); capacity
-    = M/n * top_k is exact (zero drops possible)."""
+    (rank, expert). The default is the exact M/n * top_k (zero drops —
+    lossless like every other mode); pass capacity/capacity_factor to
+    opt into the GShard drop trade, and return_drops=True to get
+    (y, drops) with this rank's dropped (token, choice) count
+    (round-4 ADVICE: the lossy mode must be detectable)."""
     n_experts = params.w_router.shape[-1]
     if mode == "fused":
         logits = jnp.dot(
@@ -77,10 +82,17 @@ def tp_moe_fwd(
             x_shard, ids, weights,
             params.w_gate_up[..., :i2], params.w_gate_up[..., i2:],
             axis, capacity=capacity, capacity_factor=capacity_factor,
+            force_kernel=force_kernel,
         )
-        return fused_moe_down_combine_rs(
+        y = fused_moe_down_combine_rs(
             act, params.w_down, meta, axis, out_dtype=x_shard.dtype,
         )
+        return (y, meta.drops) if return_drops else y
+
+    def ret(y):
+        # non-fused modes are always lossless: drops is the zero scalar
+        # (return_drops must not be silently ignored — round-5 review)
+        return (y, jnp.zeros((), jnp.int32)) if return_drops else y
     # Router on the full token set. Router logits must be identical on all
     # ranks (the sort permutation must agree), so compute from the gathered
     # tokens in f32.
@@ -104,7 +116,7 @@ def tp_moe_fwd(
             act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
         )
         y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
-        return jax.lax.psum(y, axis)
+        return ret(jax.lax.psum(y, axis))
 
     if mode == "xla":
         h = ag_group_gemm_ref(x_shard, params.w_gate_up, sort, axis)
@@ -113,10 +125,10 @@ def tp_moe_fwd(
             act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
         )
         y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
-        return jax.lax.psum_scatter(y, axis, tiled=True)
+        return ret(jax.lax.psum_scatter(y, axis, tiled=True))
 
     h = ag_group_gemm(x_shard, params.w_gate_up, sort, axis, x_full=x_full)
     act = _silu_mul(h).astype(x_shard.dtype)
-    return moe_reduce_rs(
+    return ret(moe_reduce_rs(
         act, params.w_down, sort, weights, axis, out_dtype=x_shard.dtype
-    )
+    ))

@@ -80,6 +80,21 @@ ROW = 10  # queue row: [branch, a0..a5, pf_code, pf_layer, pf_in]
 # consumes it instead of issuing a cold load.
 
 
+def physical_core_count():
+    """TensorCores per chip, from the device-kind table (PJRT devices do
+    not reliably expose num_cores). TDT_NUM_CORES overrides; unknown
+    kinds return None (caller proceeds and lets Mosaic decide)."""
+    env = os.environ.get("TDT_NUM_CORES")
+    if env:
+        return int(env)
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    if "v4" in kind or "v5p" in kind:
+        return 2  # megacore chips
+    if "lite" in kind or "v5e" in kind or "v6e" in kind:
+        return 1
+    return None
+
+
 def _fit_tile(n: int, cap: int = 512) -> int:
     """Largest divisor of n that is <= cap, preferring lane multiples."""
     best = 1
@@ -110,6 +125,7 @@ class _Env:
     vin: Any = None
     vin2: Any = None
     vout: Any = None
+    straggler: tuple = (-1, 0)  # (rank, ns) AR-branch skew injection
     vw: Any = None
     vkv: Any = None
     vrope: Any = None
@@ -402,6 +418,45 @@ def _allreduce_add_branch(key, env: _Env):
             )
             cp_loc.start()
             _maybe_prefetch(env, args[6], args[7])
+
+            def skew():
+                # race provocation (tests only): stall the straggler
+                # BETWEEN its individual puts, so its payload reaches
+                # the first peer on time but the remaining peers late.
+                # The on-time peer completes this AR and runs ahead to
+                # the NEXT one; its next-parity delivery then arrives at
+                # the still-waiting peers while the straggler's put for
+                # THIS parity is in flight — exactly the misattribution
+                # only per-parity recv semaphores prevent (a shared recv
+                # counts the early next-parity bytes and reads a stale
+                # mailbox row). Interpret-mode skew is a LOCAL-DMA
+                # churn: semaphore churn is unusable in a multi-core
+                # kernel (signal and wait can land on different cores'
+                # semaphore instances); a copy start/wait pair is the
+                # per-core pattern every branch already relies on.
+                # Native uses cycle-accurate pl.delay.
+                s_rank, s_ns = env.straggler
+                if s_ns <= 0:
+                    return
+                from triton_dist_tpu.lang.core import use_interpret
+
+                if use_interpret():
+                    @pl.when(me == s_rank)
+                    def _skew():
+                        def churn(_, c):
+                            cp = pltpu.make_async_copy(
+                                env.ws_rows(src, W),
+                                env.vin.at[:, pl.ds(0, W)], env.ld1,
+                            )
+                            cp.start()
+                            cp.wait()
+                            return c
+
+                        jax.lax.fori_loop(0, max(1, s_ns // 5000),
+                                          churn, 0)
+                else:
+                    shmem.straggler_delay(axis, *env.straggler)
+
             handles = []
             for i in range(1, n):
                 peer = jax.lax.rem(me + i, n)
@@ -416,6 +471,8 @@ def _allreduce_add_branch(key, env: _Env):
                     env.send, env.recv.at[parity], peer, axis,
                 )
                 handles.append(h)
+                if i == 1:
+                    skew()
             cp_loc.wait()
             for h in handles:
                 h.wait()
@@ -729,6 +786,7 @@ def compile_graph(
     sched: Schedule,
     dtype,
     name: str = "megakernel",
+    straggler: tuple = (-1, 0),
 ) -> CompiledMega:
     """Lower (graph, schedule) to one pallas_call (the reference's
     ModelBuilder.compile, model_builder.py:372-389: codegen + jit). The
@@ -895,6 +953,7 @@ def compile_graph(
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
+            straggler=straggler,
             ws=ws_out, weights=dict(zip(weight_names, w_refs)),
             norms=norms, rope_cs=rope_cs, k_cache=k_cache,
             v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
@@ -998,8 +1057,13 @@ def compile_graph(
                     detect_races=os.environ.get("TDT_MEGA_RACES") == "1",
                 )
             else:
-                phys = getattr(jax.devices()[0], "num_cores", 1) or 1
-                if phys < nc:
+                phys = physical_core_count()
+                if phys is not None and phys < nc:
+                    # only a POSITIVELY-known-insufficient chip raises;
+                    # unknown device kinds proceed and let Mosaic decide
+                    # (round-4 ADVICE: PJRT devices don't reliably expose
+                    # num_cores, and a fail-closed default made the
+                    # multi-core path unreachable on real megacore chips)
                     raise RuntimeError(
                         f"megakernel schedule uses {nc} cores but this "
                         f"chip has {phys} TensorCore(s); re-schedule with "

@@ -139,6 +139,7 @@ class MegaQwen3:
         fast_init: bool = False,
         donate_cache: bool = True,
         num_cores: int = 1,
+        straggler: tuple = (-1, 0),
     ):
         assert not cfg.is_moe, "megakernel covers the dense decode graph"
         from triton_dist_tpu.lang.core import use_interpret
@@ -190,7 +191,8 @@ class MegaQwen3:
         validate_schedule(self.graph, sched)
         self.sched = sched
         self.cm: CompiledMega = compile_graph(
-            self.graph, sched, dt, name=f"mega_qwen3_{axis}{n}"
+            self.graph, sched, dt, name=f"mega_qwen3_{axis}{n}",
+            straggler=straggler,
         )
         self._meta = meta
 
