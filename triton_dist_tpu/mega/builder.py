@@ -203,6 +203,7 @@ class ModelBuilder:
         k_norm_base: int = 0,
         dst: Optional[BufferHandle] = None,
         tag: str = "",
+        page: int = 0,
     ) -> Tuple[BufferHandle, BufferHandle, BufferHandle]:
         """Decode attention: qk-norm + rope + GQA over the cached prefix,
         with the new token's k/v folded into the softmax in-register
@@ -217,7 +218,7 @@ class ModelBuilder:
         self.graph.add_task(
             "attention",
             ("attention", hq_l, hkv_l, head_dim, s_max, eps, use_qk_norm,
-             q_norm_base, k_norm_base),
+             q_norm_base, k_norm_base, page),
             [layer, qkv.id, dst.id, kn.id, vn.id],
             reads=[qkv], writes=[dst, kn, vn],
             cost=estimate_gemm_ms(

@@ -116,6 +116,7 @@ class _Env:
     pb: int        # sublane-padded stripe height of one workspace slot
     wmax: int
     pos: Any = None
+    table: Any = None  # (B, MAXP) int32 page table in SMEM
     ws: Any = None
     weights: Dict[str, Any] = dataclasses.field(default_factory=dict)
     norms: Any = None
@@ -500,9 +501,13 @@ def _allreduce_add_branch(key, env: _Env):
     return body
 
 
-def _kv_chunk(smax: int) -> int:
+def _kv_chunk(smax: int, page: int = 0) -> int:
     """KV page length for the chunked attention: whole-cache at small
-    contexts (one page, the static path), 512-token pages past that."""
+    contexts (one page, the static path), 512-token pages past that.
+    page > 0 pins an explicit page size (the paged-cache mode)."""
+    if page > 0:
+        assert smax % page == 0, f"s_max {smax} % page {page} != 0"
+        return page
     if smax <= 1024:
         return smax
     assert smax % 512 == 0, f"s_max {smax} must be a multiple of 512"
@@ -514,7 +519,8 @@ def _attention_branch(key, env: _Env):
     attention task). The new token's k/v rows are written to workspace
     slots and folded into the softmax directly; the caller scatters them
     into the cache (see module docstring)."""
-    _, hq_l, hkv_l, D, SMAX, eps, use_qk_norm, q_base, k_base = key
+    (_, hq_l, hkv_l, D, SMAX, eps, use_qk_norm, q_base, k_base,
+     page) = key
     B = env.batch
     half = D // 2
     g = hq_l // hkv_l
@@ -607,28 +613,36 @@ def _attention_branch(key, env: _Env):
 
         # ---- chunked-KV online attention (flash-decode over the cache;
         # ref: mega_triton_kernel/models/paged_kv_cache.py — context
-        # scales past VMEM by streaming SCHUNK-token KV pages). The
-        # online state is SEEDED with the new token's contribution
+        # scales past VMEM by streaming SCHUNK-token KV pages). EVERY
+        # cache access indirects through the page table (SMEM): the
+        # dense cache is the identity table over its own page grid, the
+        # paged cache maps (seq, chunk) -> pool page (per-seq growth +
+        # pool sharing; the ref's page_table lookup, paged_kv_cache.py).
+        # The online state is SEEDED with the new token's contribution
         # (always unmasked), so the running max is real from the start
-        # and fully-masked chunks contribute exactly zero.
-        schunk = _kv_chunk(SMAX)
+        # and fully-masked chunks contribute exactly zero. Chunks past a
+        # sequence's prefix read table slot 0 (zero-init) — in-bounds,
+        # and their logits are position-masked to -inf.
+        schunk = _kv_chunk(SMAX, page)
         nch = SMAX // schunk
 
         def kv_start(h, ci, slot):
             for which, ref in ((0, env.k_cache), (1, env.v_cache)):
-                pltpu.make_async_copy(
-                    ref.at[layer, h, :, pl.ds(ci * schunk, schunk)],
-                    env.vkv.at[slot, which],
-                    env.kvsems.at[slot],
-                ).start()
+                for b in range(B):
+                    pid = env.table[b, ci]
+                    pltpu.make_async_copy(
+                        ref.at[layer, h, pid],
+                        env.vkv.at[slot, which, b],
+                        env.kvsems.at[slot],
+                    ).start()
 
         def kv_wait(slot):
             for which, ref in ((0, env.k_cache), (1, env.v_cache)):
-                pltpu.make_async_copy(
-                    ref.at[layer, 0, :, pl.ds(0, schunk)],
-                    env.vkv.at[slot, which],
-                    env.kvsems.at[slot],
-                ).wait()
+                for b in range(B):
+                    pltpu.make_async_copy(
+                        ref.at[0, 0, 0], env.vkv.at[slot, which, b],
+                        env.kvsems.at[slot],
+                    ).wait()
 
         def chunk_update(h, ci, state):
             """One KV page folded into the per-b online softmax state."""
@@ -908,11 +922,11 @@ def compile_graph(
         "one attention geometry per megakernel graph"
     )
     if at_keys:
-        _, hq_l, hkv_l, D, SMAX, _, _, _, _ = at_keys[0]
+        _, hq_l, hkv_l, D, SMAX, _, _, _, _, page_ = at_keys[0]
         half = D // 2
     else:
-        hkv_l, D, SMAX, half = 1, 128, 8, 64
-    SCHUNK = _kv_chunk(SMAX)
+        hkv_l, D, SMAX, half, page_ = 1, 128, 8, 64, 0
+    SCHUNK = _kv_chunk(SMAX, page_)
     ar_keys = [k for k in branch_keys if k[0] in ("allreduce_add",
                                                   "barrier")]
     arw = max((k[1] for k in ar_keys if k[0] == "allreduce_add"),
@@ -939,7 +953,7 @@ def compile_graph(
         + (4 << 20)
     )
 
-    def kernel(q_ref, pos_ref, ws_in, *rest):
+    def kernel(q_ref, pos_ref, tbl_ref, ws_in, *rest):
         nw = len(weight_names)
         w_refs = rest[:nw]
         tail = rest[nw:]
@@ -953,7 +967,7 @@ def compile_graph(
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
-            straggler=straggler,
+            table=tbl_ref, straggler=straggler,
             ws=ws_out, weights=dict(zip(weight_names, w_refs)),
             norms=norms, rope_cs=rope_cs, k_cache=k_cache,
             v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
@@ -1005,15 +1019,19 @@ def compile_graph(
                 for c2 in range(nc):
                     pltpu.semaphore_signal(sb.at[ci], 1, core_index=c2)
 
-    def run(pos, ws, weights: Dict[str, jax.Array], norms, rope_cs,
-            k, v):
+    def run(pos, table, ws, weights: Dict[str, jax.Array], norms,
+            rope_cs, k, v):
+        """k/v are PAGE POOLS (L, Hkv_loc, n_pages, SCHUNK, D); `table`
+        (B, SMAX//SCHUNK) int32 maps (seq, chunk) -> pool page. Dense
+        callers pass their cache reshaped to the page grid plus the
+        identity table (see MegaQwen3._device_step)."""
         any_spec = pl.BlockSpec(memory_space=pl.ANY)
         nw = len(weight_names)
         grid = (nc, qmax) if nc > 1 else (len(order),)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             + [any_spec] * (1 + nw + 4),
             out_specs=any_spec,
             scratch_shapes=[
@@ -1074,8 +1092,8 @@ def compile_graph(
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct(ws.shape, ws.dtype),
-            # inputs: queue(0) pos(1) ws(2) weights(3..) norms rope k v
-            input_output_aliases={2: 0},
+            # inputs: queue(0) pos(1) table(2) ws(3) weights(4..) ...
+            input_output_aliases={3: 0},
             compiler_params=compiler_params(
                 has_side_effects=True,
                 collective_id=next_collective_id(name) if world > 1
@@ -1089,8 +1107,8 @@ def compile_graph(
             **extra,
         )
         w_list = [weights[n] for n in weight_names]
-        return fn(jnp.asarray(queue), pos, ws, *w_list, norms, rope_cs,
-                  k, v)
+        return fn(jnp.asarray(queue), pos, jnp.asarray(table, jnp.int32),
+                  ws, *w_list, norms, rope_cs, k, v)
 
     return CompiledMega(
         run=run, queue=queue, n_slots=n_slots, pb=PB, wmax=wmax,

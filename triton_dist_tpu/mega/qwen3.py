@@ -67,9 +67,61 @@ class MegaKVCache(NamedTuple):
         return MegaKVCache(k, v, cache.length)
 
 
+class PagedMegaKVCache(NamedTuple):
+    """Paged decode cache (ref: mega_triton_kernel/models/
+    paged_kv_cache.py): k/v are SHARED page pools
+    (L, Hkv_loc, n_pages, PAGE, D); `table` (B, MAXP) int32 maps
+    (sequence, page index) -> pool page, allocated on demand (bump
+    allocator `next_free`) as sequences grow — ragged batches consume
+    pool pages proportional to their ACTUAL lengths, not B * S_max."""
+
+    k: jax.Array
+    v: jax.Array
+    table: jax.Array      # (B, MAXP) int32; 0 until allocated
+    length: jax.Array     # (B,)
+    next_free: jax.Array  # () int32 bump-allocator head
+
+    @staticmethod
+    def create(cfg: ModelConfig, batch: int, hkv_loc: int, page: int,
+               max_pages: int, total_pages: int) -> "PagedMegaKVCache":
+        dt = jnp.dtype(cfg.dtype)
+        shape = (cfg.num_layers, hkv_loc, total_pages, page, cfg.head_dim)
+        return PagedMegaKVCache(
+            jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+            jnp.zeros((batch, max_pages), jnp.int32),
+            jnp.zeros((batch,), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+
+    @staticmethod
+    def from_dense(cache, page: int, total_pages: int,
+                   max_pages: int) -> "PagedMegaKVCache":
+        """Page an Engine prefill cache (L, B, T, Hkv, D): each
+        sequence's prefix claims ceil(len/page) consecutive pool pages.
+        Prefill lengths are uniform here (Engine pads to T), so the page
+        walk is a static reshape + sequential table."""
+        L, B, T, Hkv, D = cache.k.shape
+        assert T % page == 0, f"prefill len {T} % page {page}"
+        used = B * (T // page)
+        assert used <= total_pages, "pool too small for the prefill"
+        k = jnp.moveaxis(cache.k, 3, 1).reshape(L, Hkv, B * (T // page),
+                                                page, D)
+        v = jnp.moveaxis(cache.v, 3, 1).reshape(L, Hkv, B * (T // page),
+                                                page, D)
+        pad = total_pages - used
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        table = jnp.zeros((B, max_pages), jnp.int32)
+        ids = jnp.arange(B * (T // page), dtype=jnp.int32).reshape(
+            B, T // page)
+        table = table.at[:, :T // page].set(ids)
+        return PagedMegaKVCache(k, v, table, cache.length,
+                                jnp.asarray(used, jnp.int32))
+
+
 def build_qwen3_graph(
     cfg: ModelConfig, batch: int, world: int, s_max: int,
-    axis: str = TP_AXIS,
+    axis: str = TP_AXIS, page: int = 0,
 ) -> Tuple[ModelBuilder, dict]:
     """The decode-step task graph (ref: Qwen3 model build over
     model_builder.make_* calls, mega_triton_kernel/models/qwen3.py).
@@ -96,7 +148,7 @@ def build_qwen3_graph(
                                  eps=cfg.rms_eps, tag=f"ln1+qkv[{l}]")
         attn, kn, vn = mb.make_attention(
             l, qkv, hq_l, hkv_l, D, s_max, cfg.rms_eps, cfg.use_qk_norm,
-            q_norm_base=2 * L + 1, k_norm_base=3 * L + 1,
+            q_norm_base=2 * L + 1, k_norm_base=3 * L + 1, page=page,
         )
         kn_bufs.append(kn)
         vn_bufs.append(vn)
@@ -140,6 +192,9 @@ class MegaQwen3:
         donate_cache: bool = True,
         num_cores: int = 1,
         straggler: tuple = (-1, 0),
+        paged: bool = False,
+        page_size: Optional[int] = None,
+        total_pages: Optional[int] = None,
     ):
         assert not cfg.is_moe, "megakernel covers the dense decode graph"
         from triton_dist_tpu.lang.core import use_interpret
@@ -185,7 +240,22 @@ class MegaQwen3:
             layers=self.params.layers._replace(w_gate=None, w_up=None)
         )
 
-        mb, meta = build_qwen3_graph(cfg, batch, n, self.s_max, axis)
+        from triton_dist_tpu.mega.kernel import _kv_chunk
+
+        self.paged = paged
+        self.page = page_size or _kv_chunk(self.s_max)
+        assert self.s_max % self.page == 0
+        self.max_pages = self.s_max // self.page
+        # shared pool size: B*max_pages reproduces dense; smaller pools
+        # SHARE capacity across ragged sequences (allocation is on
+        # demand — the point of paging)
+        self.total_pages = (total_pages if total_pages is not None
+                            else batch * self.max_pages)
+
+        mb, meta = build_qwen3_graph(
+            cfg, batch, n, self.s_max, axis,
+            page=(page_size or 0) if not paged else self.page,
+        )
         self.graph = mb.graph
         sched = schedule_graph(self.graph, num_cores=num_cores)
         validate_schedule(self.graph, sched)
@@ -214,12 +284,29 @@ class MegaQwen3:
         self._vn_rows = np.array([int(slot[b.id]) * pb
                                   for b in meta["vn_bufs"]])
 
+        from triton_dist_tpu.mega.kernel import _kv_chunk as _kc
+
+        self._schunk = _kc(self.s_max, (page_size or 0) if not paged
+                           else self.page)
+        nch_d = self.s_max // self._schunk
+        import numpy as _np
+
+        self._ident_table = jnp.asarray(
+            _np.arange(batch * nch_d, dtype=_np.int32).reshape(batch,
+                                                               nch_d))
+
         p_specs = param_specs(axis, moe=False)
         p_specs = p_specs._replace(
             layers=p_specs.layers._replace(w_gate=None, w_up=None)
         )
-        c_specs = MegaKVCache(k=P(None, axis), v=P(None, axis),
-                              length=P())
+        if paged:
+            c_specs = PagedMegaKVCache(
+                k=P(None, axis), v=P(None, axis), table=P(), length=P(),
+                next_free=P(),
+            )
+        else:
+            c_specs = MegaKVCache(k=P(None, axis), v=P(None, axis),
+                                  length=P())
 
         def step(params: DenseLLMParams, w_gate_up, tokens,
                  cache: MegaKVCache):
@@ -281,8 +368,21 @@ class MegaQwen3:
         ws = jax.lax.dynamic_update_slice(ws, x, (self._x_rows, 0))
         pos = cache.length
 
-        ws_o = self.cm.run(pos, ws, weights, norms, self._rope_cs,
-                           cache.k, cache.v)
+        if isinstance(cache, PagedMegaKVCache):
+            k_pool, v_pool, table = cache.k, cache.v, cache.table
+        else:
+            # dense cache = identity page table over its own page grid
+            # (free reshape; one kernel path serves both cache forms)
+            Lh, Hh = cache.k.shape[0], cache.k.shape[1]
+            nch = self.s_max // self._schunk
+            k_pool = cache.k.reshape(Lh, Hh, B * nch, self._schunk,
+                                     cfg.head_dim)
+            v_pool = cache.v.reshape(Lh, Hh, B * nch, self._schunk,
+                                     cfg.head_dim)
+            table = self._ident_table
+
+        ws_o = self.cm.run(pos, table, ws, weights, norms,
+                           self._rope_cs, k_pool, v_pool)
 
         hidden = jax.lax.dynamic_slice(
             ws_o, (self._final_rows, 0), (pb, self.cm.wmax)
@@ -306,11 +406,55 @@ class MegaQwen3:
         kn = jnp.moveaxis(kn, 2, 1)  # (L, Hkv, B, D)
         vn = jnp.moveaxis(vn, 2, 1)
         bidx = jnp.arange(B)
+        if isinstance(cache, PagedMegaKVCache):
+            # page allocation (bump allocator): a sequence crossing into
+            # a fresh page claims the next pool page(s) this step
+            pidx = cache.length // self.page
+            need = (cache.length % self.page) == 0
+            new_ids = (cache.next_free
+                       + jnp.cumsum(need.astype(jnp.int32)) - need)
+            table = cache.table.at[bidx, pidx].set(
+                jnp.where(need, new_ids.astype(jnp.int32),
+                          cache.table[bidx, pidx]))
+            next_free = cache.next_free + jnp.sum(need.astype(jnp.int32))
+            slots = table[bidx, pidx]
+            offs = cache.length % self.page
+            k = cache.k.at[:, :, slots, offs].set(kn.astype(dt))
+            v = cache.v.at[:, :, slots, offs].set(vn.astype(dt))
+            return logits, PagedMegaKVCache(k, v, table,
+                                            cache.length + 1, next_free)
         k = cache.k.at[:, :, bidx, cache.length].set(kn.astype(dt))
         v = cache.v.at[:, :, bidx, cache.length].set(vn.astype(dt))
         return logits, MegaKVCache(k, v, cache.length + 1)
 
     # -- public API ----------------------------------------------------------
+
+    def new_paged_cache(self) -> PagedMegaKVCache:
+        assert self.paged, "construct MegaQwen3 with paged=True"
+        cache = PagedMegaKVCache.create(
+            self.cfg, self.batch, self.hkv_loc, self.page,
+            self.max_pages, self.total_pages,
+        )
+        specs = PagedMegaKVCache(k=P(None, self.axis),
+                                 v=P(None, self.axis), table=P(),
+                                 length=P(), next_free=P())
+        return jax.tree.map(
+            lambda a, sp: jax.device_put(a, NamedSharding(self.mesh, sp)),
+            cache, specs,
+        )
+
+    def paged_cache_from_dense(self, cache) -> PagedMegaKVCache:
+        assert self.paged, "construct MegaQwen3 with paged=True"
+        pc = PagedMegaKVCache.from_dense(cache, self.page,
+                                         self.total_pages,
+                                         self.max_pages)
+        specs = PagedMegaKVCache(k=P(None, self.axis),
+                                 v=P(None, self.axis), table=P(),
+                                 length=P(), next_free=P())
+        return jax.tree.map(
+            lambda a, sp: jax.device_put(a, NamedSharding(self.mesh, sp)),
+            pc, specs,
+        )
 
     def new_cache(self) -> MegaKVCache:
         cache = MegaKVCache.create(self.cfg, self.batch, self.s_max,
