@@ -33,6 +33,8 @@ report a perfect 0.0).
 """
 
 import json
+import math
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -268,6 +270,33 @@ def bench_a2a_dispatch(mesh):
     return ms * 1e3
 
 
+def _search_best_vs_xla(candidates, build_one, xla_builder, args, label):
+    """Measure each candidate kernel builder against ONE memoized XLA arm
+    (slope_ratio_timer; the identical baseline program must not recompile
+    per candidate) and return (ratio, pallas_ms, xla_ms, label) of the
+    winner. Shared by the two fused-kernel candidate searches."""
+    from triton_dist_tpu.runtime.utils import slope_ratio_timer
+
+    xla_cache = {}
+
+    def xla_memo(k):
+        if k not in xla_cache:
+            xla_cache[k] = xla_builder(k)
+        return xla_cache[k]
+
+    best = None
+    for cand in candidates:
+        try:
+            r, pm, xm = slope_ratio_timer(build_one(cand), xla_memo, args)
+        except RuntimeError:
+            continue
+        if best is None or r < best[0]:
+            best = (r, pm, xm, label(cand))
+    if best is None:
+        raise RuntimeError("all candidate configs failed to measure")
+    return best
+
+
 def bench_ag_gemm_kernel(mesh, x, w1):
     """Ratio of the forced Pallas AG+GEMM grid to the unfused XLA
     reference (all_gather + dot; plain matmul at world=1).
@@ -316,35 +345,26 @@ def bench_ag_gemm_kernel(mesh, x, w1):
 
         return b
 
+    # Measured candidate set: the known-good measured configs plus the
+    # autotuner's model-pruned frontier at this exact shape (perf_model
+    # roofline: per-tile HBM traffic + grid-step overhead), deduped.
+    from triton_dist_tpu.autotuner import prune_ag_gemm_configs
+
     candidates = [
         (AgGemmConfig(256, 3200, 512), "arrival"),   # default (0.98x)
         (AgGemmConfig(512, 3200, 512), "arrival"),
         (AgGemmConfig(512, 1280, 1024), "arrival"),  # round-4 default
     ]
-    # one XLA baseline builder, memoized per chain length: the identical
-    # program must not recompile for every candidate
-    xla_builder = build(None, None)
-    xla_cache = {}
-
-    def xla_memo(k):
-        if k not in xla_cache:
-            xla_cache[k] = xla_builder(k)
-        return xla_cache[k]
-
-    from triton_dist_tpu.runtime.utils import slope_ratio_timer
-
-    best = None
-    for cfg, order in candidates:
-        try:
-            r, pm, xm = slope_ratio_timer(build(cfg, order), xla_memo,
-                                          (x, w1))
-        except RuntimeError:
-            continue
-        if best is None or r < best[0]:
-            best = (r, pm, xm)
-    if best is None:
-        raise RuntimeError("all ag_gemm configs failed to measure")
-    return best
+    world = mesh.devices.size
+    m_loc, n_loc = x.shape[0] // world, w1.shape[1] // world
+    seen = {repr(c) for c, _ in candidates}
+    for cfg in prune_ag_gemm_configs(m_loc, x.shape[1], n_loc, top_n=3):
+        if repr(cfg) not in seen:
+            seen.add(repr(cfg))
+            candidates.append((cfg, "arrival"))
+    return _search_best_vs_xla(
+        candidates, lambda co: build(*co), build(None, None), (x, w1),
+        lambda co: f"({co[0].tile_m},{co[0].tile_n},{co[0].tile_k})")
 
 
 def bench_gemm_rs_kernel(mesh):
@@ -365,13 +385,13 @@ def bench_gemm_rs_kernel(mesh):
     b = jnp.asarray(rng.standard_normal((K_RS, HIDDEN)) * 0.02,
                     jnp.bfloat16)
 
-    def build(forced):
+    def build(cfg):
         def bld(k):
             def per_rank(a, b):
                 def body(_, c):
-                    if forced:
+                    if cfg is not None:
                         out = gemm_rs(c, b, "tp", force_kernel=True,
-                                      config=GemmRsConfig())
+                                      config=cfg)
                     else:
                         out = gemm_rs_ref(c, b, "tp")
                     # Carry adapter: optimization_barrier, then a pure
@@ -400,9 +420,30 @@ def bench_gemm_rs_kernel(mesh):
 
         return bld
 
-    from triton_dist_tpu.runtime.utils import slope_ratio_timer
+    from triton_dist_tpu.autotuner import prune_gemm_rs_local_configs
 
-    return slope_ratio_timer(build(True), build(False), (a, b))
+    # Candidate search (tentpole (c)): the shipped default plus the
+    # model-pruned local-regime frontier at this exact shape — including
+    # the full-K nk==1 direct-store tiles the restructured
+    # _local_mm_kernel added. The tile_*_local knobs only exist in the
+    # world=1 blocked-matmul regime; at world>1 the forced kernel takes
+    # the streamed-b ring (which ignores them), so searching there would
+    # re-measure one kernel N times and record a noise-picked config.
+    candidates = [GemmRsConfig()]
+    if mesh.devices.size == 1:
+        seen = {repr(candidates[0])}
+        for cfg in prune_gemm_rs_local_configs(M, K_RS, HIDDEN, top_n=3):
+            if repr(cfg) not in seen:
+                seen.add(repr(cfg))
+                candidates.append(cfg)
+
+    def label(cfg):
+        return (f"({cfg.tile_m_local},{cfg.tile_n_local},"
+                f"{cfg.tile_k_local})"
+                if mesh.devices.size == 1 else "default(streamed)")
+
+    return _search_best_vs_xla(candidates, build, build(None), (a, b),
+                               label)
 
 
 def bench_sp_decode_partial(mesh):
@@ -457,6 +498,69 @@ def bench_sp_decode_partial(mesh):
     return r, pm * 1e3, xm * 1e3
 
 
+# Driver-facing result schema. The driver tracks metric trends by key
+# name across rounds, so a typo'd, renamed, or non-finite baseline field
+# silently breaks the trend without failing anything — check_result makes
+# that a nonzero exit instead (CI catches metric drift).
+_REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
+_STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
+                "gemm_rs_tuned_cfg"}
+_NUMERIC_KEYS = {
+    "value", "vs_baseline",
+    "mega_8b_hbm_floor_ms", "mega_8b_gap_vs_floor",
+    "engine_decode_ms", "engine_decode_vs_baseline",
+    "mega_decode_qwen3_32b_ms", "mega_32b_vs_baseline",
+    "mega_32b_hbm_floor_ms", "mega_32b_gap_vs_floor",
+    "tp_mlp_m2048_ms", "tp_mlp_vs_baseline",
+    "pallas_ag_gemm_ms", "xla_gemm_ms", "pallas_vs_xla",
+    "gemm_rs_kernel_ms", "gemm_rs_xla_ms", "gemm_rs_vs_xla",
+    "sp_decode_partial_t64k_us", "sp_decode_partial_xla_us",
+    "sp_decode_partial_vs_xla",
+    "a2a_dispatch_us",
+}
+_OTHER_KEYS = {"raw"}  # free-form chain timings
+
+
+def check_result(result: dict) -> list:
+    """Problems with a bench result dict (empty = well-formed): missing
+    required keys, keys outside the schema, or non-finite numerics. The
+    `value: -1` + `error` failure line is exempt from the finiteness
+    check on purpose — a measurement failure is a valid (tracked)
+    outcome; a malformed KEY never is."""
+    problems = []
+    for k in _REQUIRED_KEYS - set(result):
+        problems.append(f"missing required key {k!r}")
+    failed = "error" in result
+    for k, v in result.items():
+        if k.endswith("_error") or k == "error":
+            if not isinstance(v, str):
+                problems.append(f"{k!r} must be a string, got {type(v)}")
+        elif k in _NUMERIC_KEYS:
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"{k!r} must be numeric, got {type(v)}")
+            elif not math.isfinite(v) or (v < 0 and not failed):
+                problems.append(f"{k!r} has malformed value {v!r}")
+        elif k in _STRING_KEYS:
+            if not isinstance(v, str):
+                problems.append(f"{k!r} must be a string, got {type(v)}")
+        elif k not in _OTHER_KEYS:
+            problems.append(f"unknown key {k!r} (schema drift — add it "
+                            "to bench._NUMERIC_KEYS/_STRING_KEYS)")
+    return problems
+
+
+def _emit(result: dict) -> None:
+    """Print the JSON line; exit nonzero when the schema check fails
+    (after printing — a malformed line should still reach the driver's
+    log for diagnosis)."""
+    print(json.dumps(result))
+    problems = check_result(result)
+    if problems:
+        for p in problems:
+            print(f"bench.py: malformed result: {p}", file=sys.stderr)
+        sys.exit(2)
+
+
 def main():
     n = len(jax.devices())
     world = min(n, TP)
@@ -470,10 +574,10 @@ def main():
         except RuntimeError as e:
             last_err = e
     else:
-        print(json.dumps({
+        _emit({
             "metric": "mega_decode_qwen3_8b_ms", "value": -1.0,
             "unit": "ms", "vs_baseline": -1.0, "error": str(last_err)[:200],
-        }))
+        })
         return
 
     result = {
@@ -483,6 +587,13 @@ def main():
         "vs_baseline": round(ms / _BASELINE_DECODE_MS, 4),
         "raw": raw,
     }
+    # Roofline-gap tracking (docs/performance.md): the decode step is
+    # HBM-bound, so measured/floor is the bandwidth efficiency the
+    # weight-streaming pipeline is chasing — a first-class metric, not a
+    # footnote in the 32B comment.
+    floor8 = float(_hbm_floor_ms(_shard_cfg()))
+    result["mega_8b_hbm_floor_ms"] = round(floor8, 4)
+    result["mega_8b_gap_vs_floor"] = round(ms / floor8, 4)
 
     # Secondary: the jit'd Engine decode (round-3's prior headline) so the
     # megakernel-vs-engine delta stays driver-visible.
@@ -502,8 +613,9 @@ def main():
             ms32 / _BASELINE_DECODE_32B_MS, 4)
         # one-chip HBM floor for this shard: the bandwidth-efficiency
         # context for the line above (computed, not hardcoded)
-        result["mega_32b_hbm_floor_ms"] = round(
-            float(_hbm_floor_ms(_cfg_32b())), 4)
+        floor32 = float(_hbm_floor_ms(_cfg_32b()))
+        result["mega_32b_hbm_floor_ms"] = round(floor32, 4)
+        result["mega_32b_gap_vs_floor"] = round(ms32 / floor32, 4)
     except Exception as e:
         result["mega_32b_error"] = str(e)[:200]
     try:
@@ -518,17 +630,20 @@ def main():
         mlp_ms, _ = bench_mlp(mesh, x, w1[:, :half], w1[:, half:], w2)
         result["tp_mlp_m2048_ms"] = round(mlp_ms, 4)
         result["tp_mlp_vs_baseline"] = round(mlp_ms / _BASELINE_MLP_MS, 4)
-        ratio, pallas_ms, xla_ms = bench_ag_gemm_kernel(mesh, x, w1)
+        ratio, pallas_ms, xla_ms, ag_cfg = bench_ag_gemm_kernel(
+            mesh, x, w1)
         result["pallas_ag_gemm_ms"] = round(pallas_ms, 4)
         result["xla_gemm_ms"] = round(xla_ms, 4)
         result["pallas_vs_xla"] = round(ratio, 4)
+        result["ag_gemm_tuned_cfg"] = ag_cfg
     except Exception as e:
         result["secondary_metric_error"] = str(e)[:200]
     try:
-        rs_ratio, rs_ms, rs_xla_ms = bench_gemm_rs_kernel(mesh)
+        rs_ratio, rs_ms, rs_xla_ms, rs_cfg = bench_gemm_rs_kernel(mesh)
         result["gemm_rs_kernel_ms"] = round(rs_ms, 4)
         result["gemm_rs_xla_ms"] = round(rs_xla_ms, 4)
         result["gemm_rs_vs_xla"] = round(rs_ratio, 4)
+        result["gemm_rs_tuned_cfg"] = rs_cfg
     except Exception as e:
         result["gemm_rs_error"] = str(e)[:200]
     try:
@@ -543,7 +658,7 @@ def main():
     except Exception as e:
         result["a2a_dispatch_error"] = str(e)[:200]
 
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == "__main__":

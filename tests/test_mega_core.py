@@ -12,6 +12,8 @@ from triton_dist_tpu.mega.scheduler import (
     Schedule,
     after_vectors,
     monotone_watermarks,
+    predicted_stalls,
+    prefetch_specs,
     schedule_graph,
     validate_schedule,
 )
@@ -175,6 +177,114 @@ def test_multicore_slot_validation_catches_concurrent_sharing():
                      n_slots=s.n_slots, native=False)
     with pytest.raises(AssertionError):
         validate_schedule(g, s_bad)
+
+
+def mlp_chain_graph(layers=3):
+    """A realistic matmul-bearing graph (norm -> gate_up -> silu -> down
+    -> add, repeated) for the weight-streaming plan invariants."""
+    from triton_dist_tpu.mega.builder import ModelBuilder
+
+    mb = ModelBuilder(batch=2, world=1)
+    x = mb.buffer(128, "x", pinned=True)
+    h = x
+    for layer in range(layers):
+        h1 = mb.make_rms_norm(layer, h, 128, 1e-6)
+        gu = mb.make_matmul("w_gate_up", layer, h1, 128, 512)
+        act = mb.make_silu_mul(gu, 256)
+        dn = mb.make_matmul("w_down", layer, act, 256, 128)
+        h = mb.make_add(dn, h, 128)
+    mb.graph.pinned[h.id] = True
+    return mb.graph
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("num_cores", [1, 2])
+def test_prefetch_plan_covers_every_matmul(depth, num_cores):
+    """The prefetch-coverage invariant: every prefetchable matmul is
+    either fed by an issuing predecessor on its own queue or explicitly
+    flagged cold — never silently unhinted (ISSUE 1 tentpole (b))."""
+    g = mlp_chain_graph()
+    s = schedule_graph(g, num_cores=num_cores, use_native=False,
+                       pf_depth=depth)
+    validate_schedule(g, s)
+    plan = s.prefetch
+    assert plan is not None and plan.depth == depth
+    _, code_of = prefetch_specs(g.tasks)
+    assert code_of, "MLP chain must expose prefetchable weights"
+    cold = set(plan.cold)
+    for t in g.tasks:
+        if t.op == "matmul" and t.branch_key[1] in code_of:
+            fed = int(plan.consume[t.id]) > 0
+            assert fed != (t.id in cold), (
+                f"task {t.id} must be exactly fed-or-cold")
+    # a chain of matmuls must actually stream: at least one is fed
+    assert any(plan.consume[t.id] > 0 for t in g.tasks
+               if t.op == "matmul")
+
+
+def test_prefetch_deeper_arena_never_loses_coverage():
+    """Growing the rotating arena can only convert cold opens into fed
+    ones (depth bounds the number of in-flight first tiles; it never
+    forbids an issue that a shallower arena allowed)."""
+    g = mlp_chain_graph(layers=4)
+    cold_by_depth = []
+    for depth in (1, 2, 3):
+        s = schedule_graph(g, num_cores=1, use_native=False,
+                           pf_depth=depth)
+        cold_by_depth.append(set(s.prefetch.cold))
+    assert cold_by_depth[1] <= cold_by_depth[0]
+    assert cold_by_depth[2] <= cold_by_depth[1]
+
+
+def test_prefetch_plan_tamper_detected():
+    """validate_schedule replays the arena: un-flagging a cold consumer,
+    consuming an empty slot, or double-issuing into a filled slot all
+    trip the prefetch invariant."""
+    g = mlp_chain_graph()
+    s = schedule_graph(g, num_cores=1, use_native=False, pf_depth=2)
+    validate_schedule(g, s)
+    plan = s.prefetch
+    fed = [t for t in range(len(g.tasks)) if plan.consume[t] > 0]
+    assert fed
+
+    # un-flag a fed consumer: now neither fed nor cold
+    plan.consume[fed[0]] = 0
+    with pytest.raises(AssertionError):
+        validate_schedule(g, s)
+
+    s2 = schedule_graph(g, num_cores=1, use_native=False, pf_depth=2)
+    validate_schedule(g, s2)
+
+    # an issue whose tile is never consumed must not survive either
+    issuers = [t for t in range(len(g.tasks)) if s2.prefetch.issue_code[t]]
+    s2.prefetch.consume[:] = 0
+    s2.prefetch.cold = [t.id for t in g.tasks
+                        if t.op == "matmul"
+                        and t.branch_key[1] in prefetch_specs(g.tasks)[1]]
+    assert issuers
+    with pytest.raises(AssertionError):
+        validate_schedule(g, s2)  # prefetches left in flight at queue end
+
+
+@pytest.mark.parametrize("num_cores", [1, 2])
+def test_predicted_stall_recorded_and_monotone(num_cores):
+    """Schedules expose the cost-model scoreboard stall per queue, and
+    the monotone-watermark rewrite the kernel actually waits on must
+    reproduce it exactly (the no-extra-blocking theorem)."""
+    g = mlp_chain_graph()
+    s = schedule_graph(g, num_cores=num_cores, use_native=False)
+    assert s.stall is not None and len(s.stall) == num_cores
+    raw = predicted_stalls(g, s)
+    mono = predicted_stalls(g, s, monotone=True)
+    np.testing.assert_allclose(raw, np.asarray(s.stall))
+    np.testing.assert_allclose(mono, raw)
+    if num_cores == 1:
+        # one queue never waits on a scoreboard
+        assert float(raw[0]) == 0.0
+    # a corrupted recorded prediction must be caught
+    s.stall = np.asarray(s.stall) + 1.0
+    with pytest.raises(AssertionError):
+        validate_schedule(g, s)
 
 
 def test_cycle_detection(backend):

@@ -7,10 +7,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from triton_dist_tpu.lang.core import (
+    multicore_interpret_supported,
+    use_interpret,
+)
 from triton_dist_tpu.mega.qwen3 import MegaKVCache, MegaQwen3
 from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.models.engine import Engine
 from triton_dist_tpu.runtime.init import make_mesh
+
+
+def _require_multicore_interpret():
+    if use_interpret() and not multicore_interpret_supported():
+        pytest.skip("this jax's Pallas interpreter cannot emulate "
+                    "multiple TensorCores (needs InterpretParams)")
 
 
 @pytest.fixture(scope="module")
@@ -104,6 +114,7 @@ def test_mega_decode_two_cores_matches_engine(tiny_cfg, world,
     watermark waits, the HB slot plan, and the drain rows all execute.
     Race detection is enabled at world=1 (it slows the interpreter;
     one world covers the data-race question)."""
+    _require_multicore_interpret()
     if world == 1:
         monkeypatch.setenv("TDT_MEGA_RACES", "1")
     cfg = tiny_cfg
@@ -168,10 +179,15 @@ def test_standalone_op_branches_mlp_graph():
     ws = ws.at[xs:xs + B, :H].set(xv)
     pos = jnp.zeros((B,), jnp.int32)
     dummy = jnp.zeros((8, 128), jnp.float32)
+    # no attention branch in this graph: the KV pool and page table only
+    # need the kernel's default geometry (SMAX=8 -> one page per row) —
+    # pool layout (L, Hkv, n_pages, page, D) with the identity table
     kc = jnp.zeros((1, 1, B, 8, 128), jnp.float32)
+    table = jnp.arange(B, dtype=jnp.int32).reshape(B, 1)
 
     ws_o = jax.jit(lambda *a: cm.run(*a))(
-        pos, ws, {"w_gate_up": wg, "w_down": wd}, norms, dummy, kc, kc)
+        pos, table, ws, {"w_gate_up": wg, "w_down": wd}, norms, dummy,
+        kc, kc)
     slot = int(sched.buf_slot[out.id]) * cm.pb
     got = ws_o[slot:slot + B, :H]
 
@@ -231,6 +247,7 @@ def test_mega_ar_under_rank_skew(tiny_cfg, skew_rank):
     early by those deliveries and reads a stale mailbox, which this
     decode-parity check catches (2 cores, world=4, several steps so
     both parities are exercised under skew)."""
+    _require_multicore_interpret()
     cfg = tiny_cfg
     mesh = _mesh(4)
     B, S = 4, 4
@@ -253,6 +270,40 @@ def test_mega_ar_under_rank_skew(tiny_cfg, skew_rank):
             err_msg=f"skewed decode step {step} (rank {skew_rank})",
         )
         tok = jnp.argmax(lm, -1).astype(jnp.int32)
+
+
+def test_mega_pf_depth_pipeline_parity(tiny_cfg, monkeypatch):
+    """The depth-K weight-streaming arena is a pure latency optimization:
+    decode output must be BIT-identical to the legacy single-tile
+    lookahead (TDT_MEGA_PF_DEPTH=1), across several steps so hints
+    stream through attention tails and the step boundary."""
+    cfg = tiny_cfg
+    mesh = _mesh(1)
+    B, S = 2, 5
+    eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="xla",
+                 donate_cache=False, max_len=32)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    logits_ref, cache_ref = eng.prefill(prompt)
+
+    trajs = []
+    for depth in (1, 3):
+        monkeypatch.setenv("TDT_MEGA_PF_DEPTH", str(depth))
+        mega = MegaQwen3(cfg, mesh, batch=B, s_max=32, params=eng.params,
+                         donate_cache=False)
+        assert mega.sched.prefetch.depth == depth
+        mcache = MegaKVCache.from_dense(cache_ref, s_max=32)
+        tok = jnp.argmax(logits_ref, -1).astype(jnp.int32)
+        steps = []
+        for _ in range(3):
+            lm, mcache = mega.decode_step(tok, mcache)
+            steps.append(np.asarray(lm))
+            tok = jnp.argmax(lm, -1).astype(jnp.int32)
+        trajs.append(np.stack(steps))
+    np.testing.assert_array_equal(
+        trajs[0], trajs[1],
+        err_msg="depth-3 arena diverged from single-tile lookahead",
+    )
 
 
 @pytest.mark.parametrize("world", [1, 4])

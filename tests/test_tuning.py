@@ -60,6 +60,127 @@ def test_ag_gemm_bound_covers_both_sides():
     assert fused >= max(gemm, ag)
 
 
+# -- blocked-GEMM tile model + roofline pruning (ISSUE 1 tentpole (c)) -------
+
+
+def test_blocked_gemm_model_charges_tile_traffic_and_steps():
+    """The tile-aware model must separate configs the coarse roofline
+    cannot: pathologically tiny tiles pay grid-step overhead and A/B
+    re-reads; a single full-size tile converges to the plain roofline."""
+    chip = pm.CHIPS["TPU v5 lite"]
+    m, n, k = 2048, 5120, 3200
+    tiny = pm.estimate_blocked_gemm_ms(m, n, k, 128, 128, 128, chip=chip)
+    good = pm.estimate_blocked_gemm_ms(m, n, k, 512, 1280, 640, chip=chip)
+    assert tiny > 2 * good
+    one = pm.estimate_blocked_gemm_ms(m, n, k, m, n, k, chip=chip)
+    base = pm.estimate_gemm_ms(m, n, k, jnp.bfloat16, chip, 0.85)
+    assert one == pytest.approx(base, rel=0.35)
+
+
+def test_roofline_frontier_keeps_best_and_never_empties():
+    cfgs = [1, 2, 3, 4]
+    model = {1: 1.0, 2: 1.2, 3: 2.0, 4: 10.0}.get
+    kept = pm.roofline_frontier(cfgs, model, slack=1.25)
+    assert kept == [1, 2]
+    assert pm.roofline_frontier([4], model) == [4]  # best always survives
+    assert pm.roofline_frontier([], model) == []
+
+
+def test_prune_ag_gemm_configs_fit_dedupe_topn():
+    from triton_dist_tpu.autotuner import (
+        ag_gemm_config_space,
+        prune_ag_gemm_configs,
+    )
+    from triton_dist_tpu.lang.core import fit_tile
+
+    chip = pm.CHIPS["TPU v5 lite"]
+    m, k, n_loc = 2048, 5120, 6400
+    pruned = prune_ag_gemm_configs(m, k, n_loc, chip=chip)
+    assert 0 < len(pruned) < len(ag_gemm_config_space())
+    fitted = [(fit_tile(c.tile_m, m), fit_tile(c.tile_n, n_loc),
+               fit_tile(c.tile_k, k)) for c in pruned]
+    assert len(set(fitted)) == len(fitted)  # deduped by fitted tiles
+    top = prune_ag_gemm_configs(m, k, n_loc, chip=chip, top_n=3)
+    assert len(top) <= 3 and set(map(repr, top)) <= set(map(repr, pruned))
+
+
+def test_prune_fallback_when_nothing_fits_returns_single_smallest():
+    """A budget no candidate fits must not hand back the whole rejected
+    space (each overflow tiling burns a Mosaic compile failure on
+    hardware): the helper returns exactly the least-VMEM candidate."""
+    from triton_dist_tpu.autotuner import prune_ag_gemm_configs
+
+    chip = pm.CHIPS["TPU v5 lite"]
+    out = prune_ag_gemm_configs(2048, 5120, 6400, chip=chip,
+                                vmem_budget=1)
+    assert len(out) == 1
+
+
+def test_prune_gemm_rs_local_configs_respects_vmem():
+    from triton_dist_tpu.autotuner import prune_gemm_rs_local_configs
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
+    from triton_dist_tpu.lang.core import fit_tile
+
+    chip = pm.CHIPS["TPU v5 lite"]
+    m, k_loc, n_full = 2048, 3200, 5120
+    budget = GemmRsConfig().vmem_budget
+    for c in prune_gemm_rs_local_configs(m, k_loc, n_full, chip=chip):
+        tm = fit_tile(c.tile_m_local, m)
+        tn = fit_tile(c.tile_n_local, n_full)
+        tk = fit_tile(c.tile_k_local, k_loc)
+        nk = -(-k_loc // tk)
+        need = 2 * (tm * tk + tk * tn) * 2 + 2 * tm * tn * 2
+        if nk > 1:
+            need += tm * tn * 4
+        assert need <= budget, (c, need)
+
+
+# -- bench result schema (ISSUE 1 satellite: CI catches metric drift) --------
+
+
+def _load_bench():
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("tdt_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    return _load_bench()
+
+
+def test_bench_schema_accepts_wellformed(bench_mod):
+    good = {"metric": "mega_decode_qwen3_8b_ms", "value": 2.8,
+            "unit": "ms", "vs_baseline": 0.86, "raw": [1.0, 2.0],
+            "mega_8b_hbm_floor_ms": 2.31, "mega_8b_gap_vs_floor": 1.2,
+            "mega_32b_gap_vs_floor": 1.1, "pallas_vs_xla": 0.98,
+            "gemm_rs_vs_xla": 1.0, "ag_gemm_tuned_cfg": "(256,3200,512)"}
+    assert bench_mod.check_result(good) == []
+    # measurement-failure line stays valid (tracked outcome)
+    fail = {"metric": "mega_decode_qwen3_8b_ms", "value": -1.0,
+            "unit": "ms", "vs_baseline": -1.0, "error": "tunnel glitch"}
+    assert bench_mod.check_result(fail) == []
+
+
+def test_bench_schema_flags_drift(bench_mod):
+    base = {"metric": "m", "value": 1.0, "unit": "ms", "vs_baseline": 1.0}
+    assert any("unknown key" in p for p in bench_mod.check_result(
+        dict(base, mega_32b_vs_basline=1.0)))  # typo'd baseline key
+    assert any("missing required" in p for p in bench_mod.check_result(
+        {"metric": "m", "value": 1.0}))
+    assert any("malformed value" in p for p in bench_mod.check_result(
+        dict(base, pallas_vs_xla=float("nan"))))
+    assert any("malformed value" in p for p in bench_mod.check_result(
+        dict(base, value=-3.0)))  # negative latency without an error key
+    assert any("must be numeric" in p for p in bench_mod.check_result(
+        dict(base, gemm_rs_vs_xla="1.0")))
+
+
 # -- autotuner ---------------------------------------------------------------
 
 

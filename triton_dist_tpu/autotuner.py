@@ -216,14 +216,19 @@ def get_tuner(name: str) -> ContextualAutotuner:
 def ag_gemm_config_space():
     """Candidate AgGemmConfig grid for the contextual tuner (the reference
     folds these into its context factories; ours ship a measured default
-    and let `autotune` override per shape)."""
+    and let `autotune` override per shape). The wide-N rows (tn >= 1280,
+    up to the full 3200-column Qwen3-32B gate width) are where the
+    round-5 sweep found the winners — per-grid-step overhead dominates at
+    the benched shapes, so fewer/wider tiles beat traffic-optimal ones;
+    tk spanning to 5120 covers the nk==1 direct-store regime (no f32
+    accumulator round-trip, see _ag_gemm_kernel)."""
     from triton_dist_tpu.kernels.allgather_gemm import AgGemmConfig
 
     return [
         AgGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk)
-        for tm in (512, 1024, 2048)
-        for tn in (256, 640, 1024)
-        for tk in (512, 1024, 2048)
+        for tm in (256, 512, 1024, 2048)
+        for tn in (256, 640, 1024, 1280, 3200)
+        for tk in (512, 1024, 2048, 5120)
     ]
 
 
@@ -231,6 +236,119 @@ def gemm_rs_config_space():
     from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
 
     return [GemmRsConfig(tile_m=tm) for tm in (128, 256, 512, 1024)]
+
+
+def gemm_rs_local_config_space():
+    """Candidate local-regime (world=1 forced / blocked-matmul) tiles for
+    gemm_rs — the benched Qwen3-32B down-proj path. tile_k_local=3200
+    hits the nk==1 regime at the bench K (direct store, no accumulator
+    read-modify-write)."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
+
+    return [
+        GemmRsConfig(tile_m_local=tm, tile_n_local=tn, tile_k_local=tk)
+        for tm in (256, 512, 1024)
+        for tn in (640, 1280, 2560)
+        for tk in (640, 1024, 1600, 3200)
+    ]
+
+
+# -- model-pruned candidate sets (perf_model roofline pre-filter) -----------
+
+
+def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
+                           dtype, out_dtype, vmem_budget, slack, chip,
+                           top_n):
+    """Shared body of the blocked-GEMM prune helpers: keep the
+    VMEM-fitting configs on the analytic roofline frontier (perf_model.
+    estimate_blocked_gemm_ms within `slack` of the modeled optimum),
+    dedupe configs that degrade to identical fitted tiles (they measure
+    the same kernel), and optionally cap at the top_n model-ranked.
+    Mirrors the kernels' tile fitting and VMEM accounting — both fused
+    kernels double-buffer each block operand, keep a 2-deep output
+    window, and carry an f32 accumulator only when the K sweep is tiled
+    (nk > 1; nk == 1 is the direct-store regime) — so a config is never
+    measured in a degraded form the model did not score."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.lang.core import fit_tile
+    from triton_dist_tpu.perf_model import (
+        estimate_blocked_gemm_ms,
+        roofline_frontier,
+    )
+
+    dtype = dtype or jnp.bfloat16
+    isz = jnp.dtype(dtype).itemsize
+    osz = jnp.dtype(out_dtype or dtype).itemsize
+    budget = vmem_budget or default_budget
+    am, an, ak = attr_names
+
+    def fitted(cfg):
+        return (fit_tile(getattr(cfg, am), m),
+                fit_tile(getattr(cfg, an), n),
+                fit_tile(getattr(cfg, ak), k))
+
+    def vmem_need(cfg):
+        tm, tn, tk = fitted(cfg)
+        need = 2 * (tm * tk + tk * tn) * isz + 2 * tm * tn * osz
+        if -(-k // tk) > 1:
+            need += tm * tn * 4  # f32 accumulator (skipped at nk==1)
+        return need
+
+    live = [c for c in configs if vmem_need(c) <= budget]
+    if not live:
+        # nothing fits: hand back the single least-VMEM candidate rather
+        # than the whole rejected space (measuring known-overflow tilings
+        # burns a Mosaic compile failure each on hardware)
+        return [min(configs, key=vmem_need)]
+
+    def model_ms(cfg):
+        tm, tn, tk = fitted(cfg)
+        return estimate_blocked_gemm_ms(m, n, k, tm, tn, tk, dtype=dtype,
+                                        out_dtype=out_dtype, chip=chip)
+
+    seen, uniq = set(), []
+    for c in roofline_frontier(live, model_ms, slack):
+        ft = fitted(c)
+        if ft not in seen:
+            seen.add(ft)
+            uniq.append(c)
+    if top_n is not None and len(uniq) > top_n:
+        uniq = sorted(uniq, key=model_ms)[:top_n]
+    return uniq
+
+
+def prune_ag_gemm_configs(m, k, n_loc, configs=None, dtype=None,
+                          out_dtype=None, vmem_budget=None,
+                          slack=1.25, chip=None, top_n=None):
+    """Model-pruned ag_gemm candidates at one shape (see
+    _prune_blocked_configs)."""
+    from triton_dist_tpu.kernels.allgather_gemm import AgGemmConfig
+
+    configs = list(configs) if configs is not None \
+        else ag_gemm_config_space()
+    return _prune_blocked_configs(
+        m, n_loc, k, configs, ("tile_m", "tile_n", "tile_k"),
+        AgGemmConfig().vmem_budget, dtype, out_dtype, vmem_budget,
+        slack, chip, top_n)
+
+
+def prune_gemm_rs_local_configs(m, k_loc, n_full, configs=None,
+                                dtype=None, out_dtype=None,
+                                vmem_budget=None, slack=1.25,
+                                chip=None, top_n=None):
+    """Model-pruned local-regime (world=1 blocked-matmul) gemm_rs
+    candidates (see _prune_blocked_configs; the local blocked matmul
+    shares ag_gemm's (i, j, kk) grid and traffic shape)."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
+
+    configs = list(configs) if configs is not None \
+        else gemm_rs_local_config_space()
+    return _prune_blocked_configs(
+        m, n_full, k_loc, configs,
+        ("tile_m_local", "tile_n_local", "tile_k_local"),
+        GemmRsConfig().vmem_budget, dtype, out_dtype, vmem_budget,
+        slack, chip, top_n)
 
 
 def _default_key_part(argname, a):

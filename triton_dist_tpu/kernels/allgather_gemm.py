@@ -107,9 +107,11 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     b2_ref = refs.pop(0) if silu_pair else None
     ws_ref, c_ref = refs[:2]
     del refs[:2]
-    a_buf, acc = refs[:2]
-    del refs[:2]
-    acc2 = refs.pop(0) if silu_pair else None
+    a_buf = refs.pop(0)
+    # nk==1 (full-K tiles) stores the dot straight to the output block:
+    # no accumulator scratch is allocated (see the consumer below)
+    acc = refs.pop(0) if nk > 1 else None
+    acc2 = refs.pop(0) if (silu_pair and nk > 1) else None
     stage = None if arrival else refs.pop(0)
     if arrival:
         ld_sems, cp_sem, send_sem, recv_sems = refs
@@ -252,30 +254,43 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         a_wait(slot)
         a_tile = a_buf[slot]
 
-    # --- consumer: accumulate this K block on the MXU.
-    @pl.when(kk == 0)
-    def _zero():
-        acc[...] = jnp.zeros_like(acc)
-        if silu_pair:
-            acc2[...] = jnp.zeros_like(acc2)
+    # --- consumer: this K block's partial product on the MXU. nk > 1
+    # accumulates in f32 VMEM scratch; nk == 1 (full-K tile) keeps the
+    # single dot in registers and stores it directly — the zero +
+    # read-modify-write + read round-trips of the accumulator never
+    # happen (the store restructuring behind the wide-tk autotuner
+    # candidates).
+    if nk > 1:
+        @pl.when(kk == 0)
+        def _zero():
+            acc[...] = jnp.zeros_like(acc)
+            if silu_pair:
+                acc2[...] = jnp.zeros_like(acc2)
 
     # grouped mode: b blocks are (1, tk, tn) slices of a per-expert weight
     # stack, selected by the M-tile's expert (block-diagonal grouped GEMM)
     b_tile = b_ref[0] if grouped else b_ref[...]
-    acc[...] += jnp.dot(
-        a_tile, b_tile, preferred_element_type=jnp.float32
-    )
+    contrib = jnp.dot(a_tile, b_tile, preferred_element_type=jnp.float32)
+    contrib2 = None
     if silu_pair:
         b2_tile = b2_ref[0] if grouped else b2_ref[...]
-        acc2[...] += jnp.dot(
+        contrib2 = jnp.dot(
             a_tile, b2_tile, preferred_element_type=jnp.float32
         )
+    if nk > 1:
+        acc[...] += contrib
+        if silu_pair:
+            acc2[...] += contrib2
 
     # --- store the finished output tile.
     @pl.when(kk == nk - 1)
     def _store():
-        out = (_silu_mul_f32(acc[...], acc2[...]) if silu_pair
-               else acc[...]).astype(out_dtype)
+        g = contrib if nk == 1 else acc[...]
+        if silu_pair:
+            u = contrib2 if nk == 1 else acc2[...]
+            out = _silu_mul_f32(g, u).astype(out_dtype)
+        else:
+            out = g.astype(out_dtype)
         if arrival:
             # C in ring-arrival order: the block index (s*mt+i, j) is a
             # pure grid function, so the store is Mosaic's auto output
@@ -428,9 +443,12 @@ def ag_gemm(
     nk = cdiv(k, tk)
 
     # Fixed VMEM residents: B block(s) (tk, tn) x2 each (Pallas pipeline),
-    # acc(s) f32 (tm, tn), store stage (tm, tn) (x2 window when arrival).
+    # acc(s) f32 (tm, tn) — only when the K sweep is tiled (nk > 1; at
+    # nk == 1 the dot stores directly) — and the store stage (tm, tn)
+    # (x2 window when arrival).
     n_acc = 2 if silu_pair else 1
-    vmem_fixed = n_acc * (2 * tk * tn * itemsize + tm * tn * 4) \
+    vmem_fixed = n_acc * 2 * tk * tn * itemsize \
+        + (n_acc * tm * tn * 4 if nk > 1 else 0) \
         + 2 * tm * tn * out_itemsize
     # A strip cache (whole (tm, K) strip, one DMA per block per ring step,
     # reused across the j sweep) — opt-in via config, see AgGemmConfig.
@@ -465,9 +483,10 @@ def ag_gemm(
         inputs = [a_shard, b]
 
     scratch = [pltpu.VMEM((a_slots, tm, tk), a_shard.dtype)]
-    scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
-    if silu_pair:
+    if nk > 1:  # nk==1 stores the dot directly — no accumulator
         scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
+        if silu_pair:
+            scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
     if not arrival:
         scratch.append(pltpu.VMEM((tm, tn), out_dtype))
     scratch.append(pltpu.SemaphoreType.DMA((a_slots,)))
@@ -511,7 +530,9 @@ def ag_gemm(
             collective_id=(
                 next_collective_id(f"ag_gemm_{axis}") if n > 1 else None
             ),
-            vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
+            # forced wide-tile candidates may exceed the default budget:
+            # grant what the tiling actually implies
+            vmem_limit_bytes=max(cfg.vmem_budget, vmem_need) + (2 << 20),
         ),
         # launch_metadata analog (ref allgather_gemm.py:145-155).
         # flops: per-row work is 2*k*n_loc in BOTH modes (grouped rows

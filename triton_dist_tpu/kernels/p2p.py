@@ -28,6 +28,7 @@ from triton_dist_tpu.lang.core import (
     compiler_params,
     next_collective_id,
     interpret_no_headroom,
+    interpret_divergence_unsafe,
 )
 from triton_dist_tpu.runtime.init import PP_AXIS
 
@@ -87,7 +88,8 @@ def p2p_send(x: jax.Array, src_rank: int, dst_rank: int,
     n = jax.lax.axis_size(axis)
     if n == 1:
         return x
-    if interpret_no_headroom():
+    # divergence: only src puts, only dst waits (pl.when in _p2p_kernel)
+    if interpret_no_headroom() or interpret_divergence_unsafe():
         me = jax.lax.axis_index(axis)
         shifted = jax.lax.ppermute(x, axis, [(src_rank, dst_rank)])
         return jnp.where(me == dst_rank, shifted, x)

@@ -1,0 +1,268 @@
+"""Compatibility shims for legacy jax (0.4.x Pallas interpreter).
+
+The package targets the modern Pallas TPU surface; this module back-fills
+the pieces the 0.4.x CPU interpreter is missing so the interpret-mode
+test rig runs unchanged on either jax line:
+
+- remote ``semaphore_signal`` (``device_id=...``) discharge — the 0.4.x
+  rule raises ``NotImplementedError("Remote signal not implemented.")``.
+  The replacement applies the same SPMD all-gather trick the 0.4.x
+  remote-DMA discharge already uses: every rank gathers the
+  (target, inc) pairs issued along the axis this step and adds the
+  signals addressed to itself to its LOCAL semaphore value. Lockstep
+  SPMD execution (which the interpreter's remote-DMA discharge already
+  assumes) makes this exact.
+
+- ``pltpu.get_barrier_semaphore`` has no interpret path at all in 0.4.x;
+  callers use :func:`scoped_collective_sem` which swaps in a
+  ``pl.run_scoped`` REGULAR semaphore under the legacy interpreter.
+
+Scalar/LOGICAL device-id translation for remote DMA lives in
+``shmem._dma_device_id`` (the 0.4.x DMA discharge mis-handles dict mesh
+coordinates); this module only hosts the version probe and the
+primitive-level patch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _version_tuple() -> tuple:
+    parts = []
+    for p in jax.__version__.split("."):
+        digits = "".join(ch for ch in p if ch.isdigit())
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts[:3])
+
+
+#: True on the 0.4.x line, whose Pallas interpreter predates remote
+#: signals, dict device ids, barrier semaphores, and multi-core mode.
+LEGACY_JAX = _version_tuple() < (0, 5)
+
+_namespace_installed = False
+
+
+def install_jax_namespace() -> None:
+    """Back-fill the top-level jax APIs this package calls that older
+    jax (< 0.6) ships elsewhere or not at all (idempotent; no-op when
+    the current jax already has them). Called from the package root
+    BEFORE runtime/kernels import, so every module sees one surface:
+
+    - ``jax.shard_map`` — under ``jax.experimental`` with ``check_rep``
+      instead of ``check_vma`` on the old line.
+    - ``jax.sharding.get_abstract_mesh`` — absent; None routes
+      interpret-mode kernels to their safe XLA fallbacks
+      (see lang.core.interpret_no_headroom).
+    - ``jax.lax.axis_size`` — absent; axis_frame lookup.
+    """
+    global _namespace_installed
+    if _namespace_installed:
+        return
+    _namespace_installed = True
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def _compat_shard_map(f=None, /, *, mesh, in_specs, out_specs,
+                              check_vma=True, **kw):
+            kw.setdefault("check_rep", check_vma)
+            if f is None:
+                return lambda g: _shard_map(
+                    g, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, **kw)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        jax.shard_map = _compat_shard_map
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = lambda: None
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax._src import core as _jcore
+
+        def _axis_size(name):
+            return int(_jcore.axis_frame(name))
+
+        jax.lax.axis_size = _axis_size
+
+
+_installed = False
+
+
+def legacy_interpret_active() -> bool:
+    """True when kernels are being interpreted under legacy jax — the
+    condition under which the shims below (and the callers' scalar
+    device-id translation) engage."""
+    from triton_dist_tpu.lang.core import use_interpret
+
+    return LEGACY_JAX and use_interpret()
+
+
+def install() -> None:
+    """Install the legacy-interpreter patches (idempotent; no-op on
+    modern jax). Called at ``triton_dist_tpu.lang`` import."""
+    global _installed
+    if _installed or not LEGACY_JAX:
+        return
+    _installed = True
+
+    # The 0.4.x GSPMD sharding propagation check-fails (TileAssignment::
+    # Reshape on a 0-element assignment) on programs that chain two
+    # discharged interpret-mode kernels through a data dependency — every
+    # multi-kernel decode step hits it. The Shardy partitioner handles
+    # the same modules; opt in unless the user already chose.
+    if os.environ.get("JAX_USE_SHARDY_PARTITIONER") is None:
+        try:
+            jax.config.update("jax_use_shardy_partitioner", True)
+        except Exception:  # unknown flag on some builds — keep GSPMD
+            pass
+
+    from jax._src import core as jax_core
+    from jax._src.pallas import core as pl_core
+    from jax._src.pallas.mosaic import primitives as mp
+    from jax._src.state import discharge as state_discharge
+
+    prev_rule = state_discharge._discharge_rules[mp.semaphore_signal_p]
+
+    def _signal_discharge(in_avals, out_avals, *flat_args, args_tree,
+                          device_id_type):
+        (ref, transforms, inc, device_id,
+         core_index) = args_tree.unflatten(flat_args)
+        if device_id is None:
+            return prev_rule(in_avals, out_avals, *flat_args,
+                             args_tree=args_tree,
+                             device_id_type=device_id_type)
+        if core_index is not None:
+            raise NotImplementedError(
+                "remote signal with core_index under the 0.4.x "
+                "interpreter")
+        # Resolve the team axis and target rank. Dict device ids address
+        # `pe` along one axis holding the others fixed — exactly what an
+        # axis-local all_gather sees, so multi-axis meshes work too.
+        if isinstance(device_id, dict):
+            (axis, pe), = device_id.items()
+        else:
+            axis_env = jax_core.get_axis_env()
+            names = [nm for nm in axis_env.axis_sizes if nm is not None]
+            if len(names) != 1:
+                raise NotImplementedError(
+                    "scalar device_id signal needs a single-axis mesh "
+                    "under the 0.4.x interpreter")
+            axis, pe = names[0], device_id
+        me = jax.lax.axis_index(axis)
+        pes = jax.lax.all_gather(jnp.asarray(pe, jnp.int32), axis)
+        incs = jax.lax.all_gather(
+            jnp.asarray(inc, pl_core.SEMAPHORE_INTERPRET_DTYPE), axis)
+        add = jnp.sum(
+            jnp.where(pes == me, incs, jnp.zeros_like(incs))
+        ).astype(pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        sem_value = mp._transform_semaphore(ref, transforms, in_avals[0])
+        _, new_sem_value = state_discharge.transform_swap_array(
+            ref, transforms, sem_value + add)
+        return (new_sem_value,) + (None,) * (len(in_avals) - 1), ()
+
+    state_discharge._discharge_rules[mp.semaphore_signal_p] = (
+        _signal_discharge)
+
+    # Remote DMA: the 0.4.x discharge supports only single-axis meshes
+    # (LOGICAL needs exactly one named axis; MESH tree-compares the
+    # coordinate dict against the gathered axis index and TypeErrors).
+    # Replace it for single-entry mesh-coordinate dicts — `{axis: pe}`
+    # addresses rank `pe` along ONE axis holding the others at the
+    # sender's own coordinates, so gathering the (pe, payload) pairs
+    # along that axis alone is exact on any mesh; other-axis coordinates
+    # never change. Everything else delegates to the stock rule.
+    from jax import tree_util
+    from jax._src.pallas import core as _plc
+    from jax._src.pallas.mosaic import primitives as _mp
+
+    prev_dma = state_discharge._discharge_rules[mp.dma_start_p]
+
+    def _dma_start_discharge(in_avals, out_avals, *flat_args, tree,
+                             device_id_type):
+        unflat = tree_util.tree_unflatten(tree, flat_args)
+        (src_ref, src_transforms, dst_ref, dst_transforms,
+         dst_sem, dst_sem_transforms, src_sem, src_sem_transforms,
+         device_id) = unflat
+        if not (isinstance(device_id, dict) and len(device_id) == 1):
+            return prev_dma(in_avals, out_avals, *flat_args, tree=tree,
+                            device_id_type=device_id_type)
+        (shard_axis, pe), = device_id.items()
+        avals = tree_util.tree_unflatten(tree, in_avals)
+        (_, src_tf_avals, _, dst_tf_avals, dst_sem_aval,
+         dst_sem_tf_avals, src_sem_aval, src_sem_tf_avals, _) = avals
+
+        n_src_sem_tf = len(tree_util.tree_leaves(src_sem_tf_avals))
+        n_dst_sem_tf = len(tree_util.tree_leaves(dst_sem_tf_avals))
+        n_src_tf = len(tree_util.tree_leaves(src_tf_avals))
+        n_dst_tf = len(tree_util.tree_leaves(dst_tf_avals))
+
+        updates = state_discharge.transform_array(src_ref, src_transforms)
+        local_src = updates
+
+        my_axis = jax.lax.axis_index(shard_axis)
+        who_copy_to_me = jax.lax.all_gather(pe, shard_axis) == my_axis
+        index = jnp.argmax(who_copy_to_me, axis=0)
+        global_updates = jax.lax.all_gather(updates, shard_axis)
+        updates = jax.lax.dynamic_index_in_dim(
+            global_updates, index, axis=0, keepdims=False)
+        # asymmetric dst indexing: take the SENDER's dst transforms
+        global_dst_tf = tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, shard_axis), dst_transforms)
+        dst_transforms = tree_util.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(
+                x, index, axis=0, keepdims=False),
+            global_dst_tf)
+
+        _, new_dst = state_discharge.transform_swap_array(
+            dst_ref, dst_transforms, updates)
+
+        recv_size = jnp.array(
+            jnp.minimum(updates.size, _plc.SEMAPHORE_MAX_VALUE),
+            dtype=_plc.SEMAPHORE_INTERPRET_DTYPE)
+        dst_sem_value = _mp._transform_semaphore(
+            dst_sem, dst_sem_transforms, dst_sem_aval)
+        _, new_dst_sem = state_discharge.transform_swap_array(
+            dst_sem, dst_sem_transforms, dst_sem_value + recv_size)
+        send_size = jnp.array(
+            jnp.minimum(local_src.size, _plc.SEMAPHORE_MAX_VALUE),
+            dtype=_plc.SEMAPHORE_INTERPRET_DTYPE)
+        src_sem_value = _mp._transform_semaphore(
+            src_sem, src_sem_transforms, src_sem_aval)
+        _, new_src_sem = state_discharge.transform_swap_array(
+            src_sem, src_sem_transforms, src_sem_value + send_size)
+
+        new_vals = (None,) + (None,) * n_src_tf
+        new_vals += (new_dst,) + (None,) * n_dst_tf
+        new_vals += (new_dst_sem,) + (None,) * n_dst_sem_tf
+        new_vals += (new_src_sem,) + (None,) * n_src_sem_tf
+        new_vals += (None,)  # device_id (single leaf of the dict)
+        assert len(new_vals) == len(in_avals)
+        return new_vals, ()
+
+    state_discharge._discharge_rules[mp.dma_start_p] = _dma_start_discharge
+
+
+def scoped_collective_sem(body) -> None:
+    """Run ``body(sem)`` with a collective-barrier-class semaphore.
+
+    Modern path: the hardware barrier semaphore selected by the
+    surrounding kernel's collective_id. Legacy interpreter: a
+    ``pl.run_scoped`` REGULAR semaphore — the interpreter executes ranks
+    in lockstep, so a fresh zeroed semaphore plus the patched remote
+    signal reproduces barrier semantics (each rank's instance receives
+    exactly the signals addressed to it)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if legacy_interpret_active():
+        pl.run_scoped(body, pltpu.SemaphoreType.REGULAR)
+    else:
+        body(pltpu.get_barrier_semaphore())

@@ -11,6 +11,7 @@ including inter-chip remote DMA — runs on a virtual
 from __future__ import annotations
 
 import functools
+import inspect
 import os
 from typing import Any, Optional
 
@@ -20,6 +21,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _FORCE_INTERPRET = os.environ.get("TDT_FORCE_INTERPRET", "") == "1"
+
+# Older jax (< 0.6) names the params class TPUCompilerParams and drives
+# interpret mode with a plain boolean (no InterpretParams class).
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+_HAS_INTERPRET_PARAMS = hasattr(pltpu, "InterpretParams")
+
+
+def multicore_interpret_supported() -> bool:
+    """True when this jax's interpreter can emulate multiple TensorCores
+    (InterpretParams(num_cores_or_threads=...)). The 0.4.x interpreter
+    cannot; multi-core megakernel tests skip there."""
+    return _HAS_INTERPRET_PARAMS
+
+
+def interpret_params(**kw):
+    """pltpu.InterpretParams when available, else the legacy boolean
+    (kw like num_cores_or_threads only exist on the modern class)."""
+    if _HAS_INTERPRET_PARAMS:
+        return pltpu.InterpretParams(**kw)
+    if kw:
+        raise RuntimeError(
+            "this jax version's interpret mode does not support "
+            f"InterpretParams({kw}); upgrade jax for multi-core interpret"
+        )
+    return True
 
 
 @functools.lru_cache(maxsize=None)
@@ -48,7 +75,7 @@ def tpu_call(kernel, **kwargs):
     global _PALLAS_CALLS
     _PALLAS_CALLS += 1
     if use_interpret() and "interpret" not in kwargs:
-        kwargs["interpret"] = pltpu.InterpretParams()
+        kwargs["interpret"] = interpret_params()
     return pl.pallas_call(kernel, **kwargs)
 
 
@@ -69,6 +96,14 @@ def interpret_no_headroom() -> bool:
     """
     if not use_interpret():
         return False
+    from triton_dist_tpu.lang import _compat
+
+    if _compat.LEGACY_JAX:
+        # The 0.4.x interpreter is discharge-based (remote DMA/signals
+        # lower to lockstep all_gathers at trace time): nothing blocks a
+        # thunk-executor thread, so the pool-exhaustion deadlock this
+        # guard exists for cannot occur — always run the real protocol.
+        return False
     m = jax.sharding.get_abstract_mesh()
     if m is not None and m.shape:
         import math
@@ -79,6 +114,19 @@ def interpret_no_headroom() -> bool:
     # non-blocking XLA path (a wrong False here deadlocks; a wrong True
     # only skips the overlap protocol).
     return True
+
+
+def interpret_divergence_unsafe() -> bool:
+    """True when kernels whose remote ops sit under rank-divergent
+    control flow (``pl.when(me == r)`` around a put/signal) must take
+    their XLA fallback: the legacy interpreter discharges remote DMA and
+    signals into lockstep collectives that EVERY rank must execute, so a
+    rank skipping the branch hangs the gather. Uniform-flow kernels
+    (every rank puts each step) are exact under that discharge and keep
+    the real protocol — see interpret_no_headroom."""
+    from triton_dist_tpu.lang import _compat
+
+    return _compat.legacy_interpret_active()
 
 
 def cdiv(a: int, b: int) -> int:
@@ -152,15 +200,23 @@ def next_collective_id(name: str) -> int:
     return _COLLECTIVE_IDS[name]
 
 
+# probed once, like _COMPILER_PARAMS_CLS: older jax has no
+# remote_bytes_transferred field on CostEstimate
+_COST_ESTIMATE_FIELDS = frozenset(
+    inspect.signature(pl.CostEstimate).parameters)
+
+
 def cost_estimate(flops: int = 0, bytes_accessed: int = 0,
                   remote_bytes: int = 0) -> "pl.CostEstimate":
     """Kernel cost metadata — the reference's `launch_metadata` flops/
     bytes reporting (ref: allgather_gemm.py:145-155) — consumed by the
     XLA scheduler and surfaced in profiles."""
-    return pl.CostEstimate(
+    args = dict(
         flops=int(flops), bytes_accessed=int(bytes_accessed),
         transcendentals=0, remote_bytes_transferred=int(remote_bytes),
     )
+    return pl.CostEstimate(
+        **{k: v for k, v in args.items() if k in _COST_ESTIMATE_FIELDS})
 
 
 def compiler_params(
@@ -168,7 +224,7 @@ def compiler_params(
     collective_id: Optional[int] = None,
     vmem_limit_bytes: Optional[int] = None,
     **kw: Any,
-) -> pltpu.CompilerParams:
+):
     args: dict = dict(kw)
     if has_side_effects:
         args["has_side_effects"] = True
@@ -176,4 +232,8 @@ def compiler_params(
         args["collective_id"] = collective_id
     if vmem_limit_bytes is not None:
         args["vmem_limit_bytes"] = vmem_limit_bytes
-    return pltpu.CompilerParams(**args)
+    import dataclasses
+
+    known = {f.name for f in dataclasses.fields(_COMPILER_PARAMS_CLS)}
+    return _COMPILER_PARAMS_CLS(
+        **{k: v for k, v in args.items() if k in known})

@@ -32,6 +32,10 @@ import jax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.lang import _compat
+
+_compat.install()
+
 # --- signal ops / comparison constants (ref: libshmem_device.py:293-323) ---
 SIGNAL_SET = 0
 SIGNAL_ADD = 1
@@ -68,6 +72,14 @@ def team_device_id(axis: AxisName, pe) -> dict:
     raise NotImplementedError(
         "multi-axis teams: linearize explicitly with team_linear_device_id"
     )
+
+
+def _dma_device_id(axis: AxisName, pe) -> tuple:
+    """(device_id, device_id_type) for a remote DMA addressing `pe` on
+    team `axis` — always the mesh-coordinate dict; under the legacy
+    interpreter the _compat discharge rule gives single-entry dicts
+    exact lockstep semantics on any mesh."""
+    return team_device_id(axis, pe), pltpu.DeviceIdType.MESH
 
 
 def team_linear_device_id(axes: Sequence[str], pe) -> dict:
@@ -114,13 +126,14 @@ def putmem_nbi(
     i.e. every put is implicitly a put-with-signal; `putmem_signal_nbi`
     below only differs by signal amount.
     """
+    device_id, id_type = _dma_device_id(axis, pe)
     copy = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id=team_device_id(axis, pe),
-        device_id_type=pltpu.DeviceIdType.MESH,
+        device_id=device_id,
+        device_id_type=id_type,
     )
     copy.start()
     return PutHandle(copy)
@@ -227,21 +240,23 @@ def barrier_all(axis: AxisName) -> None:
         n = 1
         for ax in axis:
             n = n * jax.lax.axis_size(ax)
-    bsem = pltpu.get_barrier_semaphore()
 
-    def body(i, _):
-        pltpu.semaphore_signal(
-            bsem,
-            inc=1,
-            device_id=team_device_id(axis, i)
-            if isinstance(axis, str)
-            else team_linear_device_id(axis, i),
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        return _
+    def with_sem(bsem):
+        def body(i, _):
+            pltpu.semaphore_signal(
+                bsem,
+                inc=1,
+                device_id=team_device_id(axis, i)
+                if isinstance(axis, str)
+                else team_linear_device_id(axis, i),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            return _
 
-    jax.lax.fori_loop(0, n, body, None)
-    pltpu.semaphore_wait(bsem, n)
+        jax.lax.fori_loop(0, n, body, None)
+        pltpu.semaphore_wait(bsem, n)
+
+    _compat.scoped_collective_sem(with_sem)
 
 
 def neighbor_barrier(axis: str, me, n: int) -> None:
@@ -250,13 +265,15 @@ def neighbor_barrier(axis: str, me, n: int) -> None:
     entered the kernel. Cheaper than barrier_all when only neighbors
     communicate (ref: the cuStreamWriteValue barrier preambles of
     kernels/nvidia/allgather.py:106-138)."""
-    bsem = pltpu.get_barrier_semaphore()
-    for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
-        pltpu.semaphore_signal(
-            bsem, inc=1, device_id={axis: d},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-    pltpu.semaphore_wait(bsem, 2)
+    def with_sem(bsem):
+        for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id={axis: d},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(bsem, 2)
+
+    _compat.scoped_collective_sem(with_sem)
 
 
 def sync_all(axis: AxisName) -> None:
@@ -296,14 +313,18 @@ def straggler_delay(axis: AxisName, rank, nanos: int, sem=None) -> None:
     @pl.when(me == rank)
     def _():
         if use_interpret():
-            csem = pltpu.get_barrier_semaphore() if sem is None else sem
+            def with_sem(csem):
+                def churn(_, carry):
+                    pltpu.semaphore_signal(csem, inc=1)
+                    pltpu.semaphore_wait(csem, 1)
+                    return carry
 
-            def churn(_, carry):
-                pltpu.semaphore_signal(csem, inc=1)
-                pltpu.semaphore_wait(csem, 1)
-                return carry
+                jax.lax.fori_loop(0, max(1, nanos // 5000), churn, 0)
 
-            jax.lax.fori_loop(0, max(1, nanos // 5000), churn, 0)
+            if sem is None:
+                _compat.scoped_collective_sem(with_sem)
+            else:
+                with_sem(sem)
         else:
             pl.delay(nanos)
 
@@ -370,6 +391,15 @@ def broadcast(dst_ref, src_ref, send_sem, recv_sem, root, axis: str,
     barrier the team before the FIRST collective of a kernel (same
     precondition as fcollect): a put must never land in a peer that has
     not yet entered the kernel."""
+    if _compat.legacy_interpret_active():
+        # The 0.4.x interpreter discharges remote DMA through lockstep
+        # all_gathers: the divergent root-only send below would deadlock
+        # the gather. Value-level broadcast is exact in that lockstep
+        # model (interpret only — never reached on hardware).
+        data = jax.lax.all_gather(src_ref[...], axis)
+        dst_ref[...] = jax.lax.dynamic_index_in_dim(data, root, 0,
+                                                    keepdims=False)
+        return
     me = my_pe(axis)
 
     @pl.when(me == root)
@@ -390,11 +420,12 @@ def broadcast(dst_ref, src_ref, send_sem, recv_sem, root, axis: str,
     @pl.when(me != root)
     def _recv():
         # wait descriptor: same shape/sems as the incoming put
+        device_id, id_type = _dma_device_id(axis, me)
         pltpu.make_async_remote_copy(
             src_ref=src_ref, dst_ref=dst_ref,
             send_sem=send_sem, recv_sem=recv_sem,
-            device_id=team_device_id(axis, me),
-            device_id_type=pltpu.DeviceIdType.MESH,
+            device_id=device_id,
+            device_id_type=id_type,
         ).wait_recv()
 
 

@@ -18,6 +18,26 @@ import dataclasses
 from typing import Dict, Hashable, List, Sequence, Tuple
 
 
+def fit_mm_tile(n: int, cap: int = 512) -> int:
+    """Largest divisor of n that is <= cap, preferring lane multiples.
+
+    Shared by the megakernel's matmul tiling and the scheduler's prefetch
+    planner — both must agree on each matmul's (K, TN) tile or the
+    prefetch-coverage invariant would be checked against the wrong
+    weight-tile geometry. Deliberately NOT named fit_tile: lang.core.
+    fit_tile is a different algorithm with swapped argument roles
+    ((tile, dim) vs this (n, cap)); sharing the name invited silently
+    wrong tiles."""
+    best = 1
+    for t in range(min(cap, n), 0, -1):
+        if n % t == 0:
+            if t % 128 == 0 or t == n:
+                return t
+            if best == 1:
+                best = t
+    return best
+
+
 @dataclasses.dataclass(frozen=True)
 class BufferHandle:
     """One logical activation tensor: a B-row × width stripe of the

@@ -68,16 +68,41 @@ from triton_dist_tpu.lang.core import (
     round_up,
     tpu_call,
 )
-from triton_dist_tpu.mega.core import Graph
-from triton_dist_tpu.mega.scheduler import Schedule, monotone_watermarks
+from triton_dist_tpu.mega.core import Graph, fit_mm_tile
+from triton_dist_tpu.mega.scheduler import (
+    Schedule,
+    default_pf_depth,
+    monotone_watermarks,
+    plan_prefetch,
+    plan_store_forward,
+)
 
-ROW = 10  # queue row: [branch, a0..a5, pf_code, pf_layer, pf_in]
+# Queue row layout (all static, built at compile time):
+#   [branch, a0..a5,
+#    pf_code, pf_layer, pf_slot, pf_in,      # weight-streaming pipeline
+#    pend_w, pend_early, defer_st, fwd_in]   # store/forward pipeline
+#
 # pf_*: cross-task weight prefetch (the reference's prefetch tasks, mega
-# kernels/prefetch.py, made implicit): the scheduler knows the next task
-# statically, so each row carries the NEXT matmul's weight id+layer; the
-# running task starts that first tile's DMA as early as its own DMA
-# ordering allows (see _maybe_prefetch), and the next matmul (pf_in=1)
-# consumes it instead of issuing a cold load.
+# kernels/prefetch.py, made implicit). The scheduler's prefetch plan
+# (scheduler.plan_prefetch) assigns each upcoming matmul's first weight
+# tile to an EARLIER row of the same queue: that row starts the DMA into
+# rotating arena slot pf_slot as early as its own DMA ordering allows
+# (see _maybe_prefetch), and the consuming matmul (pf_in = slot+1; 0 =
+# cold) reads the arena instead of issuing a cold load. With arena depth
+# >= 2 the issue site may be several tasks upstream — the hint streams
+# through attention KV tails and AR wait windows without clobbering the
+# tile the current matmul is about to consume.
+#
+# pend_w / pend_early / defer_st / fwd_in: the cross-task store pipeline
+# (single-core only). defer_st=1 tells a task to leave its workspace
+# store in flight instead of blocking on it; the FOLLOWING row drains it
+# (pend_w = 1+index into the static store-width table) either before its
+# own workspace loads (pend_early=1, required when its reads alias the
+# stored slot) or just before it first overwrites vout. fwd_in=1 means
+# this task's main input is the immediately preceding task's result and
+# is read straight out of vout (VMEM) — the HBM store+load round trip
+# leaves the critical path entirely.
+ROW = 15
 
 
 def physical_core_count():
@@ -95,16 +120,9 @@ def physical_core_count():
     return None
 
 
-def _fit_tile(n: int, cap: int = 512) -> int:
-    """Largest divisor of n that is <= cap, preferring lane multiples."""
-    best = 1
-    for t in range(min(cap, n), 0, -1):
-        if n % t == 0:
-            if t % 128 == 0 or t == n:
-                return t
-            if best == 1:
-                best = t
-    return best
+# single tiling definition shared with the scheduler's prefetch planner
+# (mega/core.fit_mm_tile): both sides must agree on each matmul's (K, TN)
+_fit_tile = fit_mm_tile
 
 
 @dataclasses.dataclass
@@ -135,6 +153,9 @@ class _Env:
     vpf: Any = None
     pfsem: Any = None
     pf_specs: Any = None  # [(wname, K, TN)] in weight-name order
+    pf_depth: int = 1     # rotating prefetch-arena slots
+    store_widths: Any = ()  # static store-width table (pend_w indexes it)
+    chsem: Any = None       # scratch sem for the interpret-mode AR churn
     mailbox: Any = None
     ld1: Any = None
     ld2: Any = None
@@ -166,32 +187,74 @@ def _silu_f32(g, u):
 # -- branch builders (one per op kind; key carries the static config) --------
 
 
-def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int):
+def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int, slot):
     """THE prefetch descriptor: start (issuer) and wait (consumer) must
     reconstruct it identically for the semaphore accounting to balance —
-    single construction site for both."""
+    single construction site for both. `slot` selects the rotating arena
+    slot (and its per-slot semaphore), so up to pf_depth first tiles can
+    be in flight across task boundaries."""
     return pltpu.make_async_copy(
         env.weights[wname].at[layer, :, pl.ds(0, TN)],
-        env.vpf.at[pl.ds(0, K), pl.ds(0, TN)],
-        env.pfsem,
+        env.vpf.at[slot, pl.ds(0, K), pl.ds(0, TN)],
+        env.pfsem.at[slot],
     )
 
 
-def _maybe_prefetch(env: _Env, pf_code, pf_layer):
-    """Start the next matmul's first weight tile (hinted by the queue
-    row). Branches that mark handles_prefetch issue it as EARLY as their
-    own DMA ordering allows — right after queueing their input loads
-    (rms/silu/add/AR), after the last own weight tile is queued (matmul
-    nt>1; at nt==1 the epilogue, to not overwrite vpf while its own
-    prefetched tile is read), during the last KV load (attention), or
-    before the rank wait (barrier). Measured on the 8B decode chain,
-    early-within-task beats end-of-task by ~1.6%. Every current branch
-    sets handles_prefetch; the dispatch wrapper's fallback only guards
-    future branches that forget to."""
+def _maybe_prefetch(env: _Env, pf_code, pf_layer, pf_slot):
+    """Start an upcoming matmul's first weight tile (hinted by the queue
+    row; assigned by scheduler.plan_prefetch). Branches that mark
+    handles_prefetch issue it as EARLY as their own DMA ordering allows —
+    right after queueing their input loads (rms/silu/add/AR), after the
+    last own weight tile is queued (matmul), during the last KV load
+    (attention), or before the rank wait (barrier). Measured on the 8B
+    decode chain, early-within-task beats end-of-task by ~1.6%. Every
+    current branch sets handles_prefetch; the dispatch wrapper's fallback
+    only guards future branches that forget to."""
     for wi, (wname, K, TN) in enumerate(env.pf_specs):
         @pl.when(pf_code == wi + 1)
         def _(wname=wname, K=K, TN=TN):
-            _pf_copy(env, wname, pf_layer, K, TN).start()
+            _pf_copy(env, wname, pf_layer, K, TN, pf_slot).start()
+
+
+def _pf_args(args):
+    """(pf_code, pf_layer, pf_slot) triple from a queue row."""
+    return args[6], args[7], args[8]
+
+
+def _drain_pending(env: _Env, pend_w):
+    """Wait the PREVIOUS task's deferred workspace store (see the ROW
+    comment). pend_w indexes the static store-width table + 1, so the
+    wait descriptor reconstructs the exact byte count the deferred
+    store's start put on env.st."""
+    for i, w in enumerate(env.store_widths):
+        @pl.when(pend_w == i + 1)
+        def _(w=w):
+            pltpu.make_async_copy(
+                env.vout.at[:, pl.ds(0, w)],
+                env.ws.at[pl.ds(0, env.pb), pl.ds(0, w)],
+                env.st,
+            ).wait()
+
+
+def _drain_late(env: _Env, args):
+    """The pend_early=0 drain: called by branch bodies right before they
+    first overwrite vout (the deferred store's source)."""
+    pend_w, pend_early = args[10], args[11]
+
+    @pl.when(jnp.logical_and(pend_w > 0, pend_early == 0))
+    def _():
+        _drain_pending(env, pend_w)
+
+
+def _finish_store(env: _Env, st, args):
+    """Start the task's workspace store; block on it only when the row
+    does not defer (defer_st=0: multi-core queues, or the queue's last
+    row — the next row's _drain_pending otherwise picks it up)."""
+    st.start()
+
+    @pl.when(args[12] == 0)
+    def _():
+        st.wait()
 
 
 
@@ -211,6 +274,7 @@ def _matmul_branch(key, env: _Env):
     in_w = 2 * K if prologue == "silu" else K
     pf_eligible = any(w == wname and kk == K and tn == TN
                       for w, kk, tn in env.pf_specs)
+    VW = env.vw.shape[0]  # own-tile arena depth (outstanding DMAs = VW-1)
 
     def wcopy(layer, j, slot):
         return pltpu.make_async_copy(
@@ -221,11 +285,14 @@ def _matmul_branch(key, env: _Env):
 
     def body(args):
         layer, src, dst, nrow = args[0], args[1], args[2], args[3]
-        pf_in = args[8]
+        pf_in, fwd_in = args[9], args[13]
         cp_in = pltpu.make_async_copy(
             env.ws_rows(src, in_w), env.vin.at[:, pl.ds(0, in_w)], env.ld1
         )
-        cp_in.start()
+
+        @pl.when(fwd_in == 0)
+        def _load():
+            cp_in.start()
 
         if pf_eligible:
             @pl.when(pf_in == 0)
@@ -238,51 +305,70 @@ def _matmul_branch(key, env: _Env):
                 env.norms.at[pl.ds(nrow * 8, 8)], env.vnq, env.ld2
             )
             cp_w.start()
-        cp_in.wait()
+
+        def _from_ws():
+            cp_in.wait()
+            return env.vin[:, :in_w]
+
+        def _from_fwd():
+            # previous task's result still lives in vout — skip the HBM
+            # round trip (its deferred store only READS vout: safe)
+            return env.vout[:, :in_w]
+
+        raw = jax.lax.cond(fwd_in == 1, _from_fwd, _from_ws)
         if prologue == "rms":
             cp_w.wait()
             a = _rms_f32(
-                env.vin[:, :K].astype(jnp.float32),
+                raw[:, :K].astype(jnp.float32),
                 env.vnq[0, :K].astype(jnp.float32), eps,
             ).astype(env.dtype)
         elif prologue == "silu":
             a = _silu_f32(
-                env.vin[:, :K].astype(jnp.float32),
-                env.vin[:, K:2 * K].astype(jnp.float32),
+                raw[:, :K].astype(jnp.float32),
+                raw[:, K:2 * K].astype(jnp.float32),
             ).astype(env.dtype)
         else:
-            a = env.vin[:, :K]
+            a = raw[:, :K]
+        # about to overwrite vout (the deferred store's source)
+        _drain_late(env, args)
         for j in range(nt):
-            if j + 1 < nt:
-                wcopy(layer, j + 1, (j + 1) % 2).start()
-            if j == nt - 1 and nt > 1:
-                # all own tiles are queued: queue the next task's first
-                # weight tile NOW, before the last wait+dot, so the
+            # keep VW-1 own-tile DMAs in flight ahead of the dot
+            if j == 0:
+                for ah in range(1, VW):
+                    if ah < nt:
+                        wcopy(layer, ah, ah % VW).start()
+            elif j + VW - 1 < nt:
+                wcopy(layer, j + VW - 1, (j + VW - 1) % VW).start()
+            if j == nt - 1 and (nt > 1 or env.pf_depth > 1):
+                # all own tiles are queued: queue the hinted matmul's
+                # first weight tile NOW, before the last wait+dot, so the
                 # weight stream never drains at the task boundary. (At
-                # nt==1 this would overwrite vpf while this task's own
-                # prefetched tile is being read — epilogue issue below.)
-                _maybe_prefetch(env, args[6], args[7])
+                # nt==1 this is only safe with a rotating arena — the
+                # depth-1 arena would overwrite the tile this task is
+                # reading; that case issues in the epilogue below.)
+                _maybe_prefetch(env, *_pf_args(args))
             if j == 0:
                 if pf_eligible:
                     def _from_prefetch():
-                        _pf_copy(env, wname, layer, K, TN).wait()
-                        return env.vpf[:K, :TN]
+                        slot = pf_in - 1
+                        _pf_copy(env, wname, layer, K, TN, slot).wait()
+                        return env.vpf[slot, :K, :TN]
 
                     def _from_cold():
                         wcopy(layer, 0, 0).wait()
                         return env.vw[0, :K, :TN]
 
-                    w_tile = jax.lax.cond(pf_in == 1, _from_prefetch,
+                    w_tile = jax.lax.cond(pf_in > 0, _from_prefetch,
                                           _from_cold)
                 else:
                     # weight excluded from prefetching (non-unique
-                    # (K, TN)): pf_in is statically never 1 for this
+                    # (K, TN)): pf_in is statically never > 0 for this
                     # branch and vpf may be smaller than (K, TN)
                     wcopy(layer, 0, 0).wait()
                     w_tile = env.vw[0, :K, :TN]
             else:
-                wcopy(layer, j, j % 2).wait()
-                w_tile = env.vw[j % 2, :K, :TN]
+                wcopy(layer, j, j % VW).wait()
+                w_tile = env.vw[j % VW, :K, :TN]
             acc = jax.lax.dot_general(
                 a, w_tile, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -292,9 +378,12 @@ def _matmul_branch(key, env: _Env):
             env.vout.at[:, pl.ds(0, N)], env.ws_rows(dst, N), env.st
         )
         st.start()
-        if nt == 1:
-            _maybe_prefetch(env, args[6], args[7])
-        st.wait()
+        if nt == 1 and env.pf_depth == 1:
+            _maybe_prefetch(env, *_pf_args(args))
+
+        @pl.when(args[12] == 0)
+        def _wait_store():
+            st.wait()
 
     body.handles_prefetch = True
     return body
@@ -305,6 +394,7 @@ def _rms_norm_branch(key, env: _Env):
 
     def body(args):
         nrow, src, dst = args[0], args[1], args[2]
+        fwd_in = args[13]
         cp_in = pltpu.make_async_copy(
             env.ws_rows(src, W), env.vin.at[:, pl.ds(0, W)], env.ld1
         )
@@ -313,19 +403,28 @@ def _rms_norm_branch(key, env: _Env):
         cp_w = pltpu.make_async_copy(
             env.norms.at[pl.ds(nrow * 8, 8)], env.vnq, env.ld2
         )
-        cp_in.start()
+
+        @pl.when(fwd_in == 0)
+        def _load():
+            cp_in.start()
+
         cp_w.start()
-        _maybe_prefetch(env, args[6], args[7])
-        cp_in.wait()
+        _maybe_prefetch(env, *_pf_args(args))
+
+        def _from_ws():
+            cp_in.wait()
+            return env.vin[:, :W]
+
+        raw = jax.lax.cond(fwd_in == 1, lambda: env.vout[:, :W], _from_ws)
         cp_w.wait()
-        y = _rms_f32(env.vin[:, :W].astype(jnp.float32),
+        y = _rms_f32(raw.astype(jnp.float32),
                      env.vnq[0, :W].astype(jnp.float32), eps)
+        _drain_late(env, args)
         env.vout[:, :W] = y.astype(env.dtype)
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
         )
-        st.start()
-        st.wait()
+        _finish_store(env, st, args)
 
     body.handles_prefetch = True
     return body
@@ -336,20 +435,32 @@ def _silu_mul_branch(key, env: _Env):
 
     def body(args):
         src, dst = args[0], args[1]
+        fwd_in = args[13]
         cp_in = pltpu.make_async_copy(
-            env.ws_rows(src, 2 * I), env.vin.at[:, pl.ds(0, 2 * I)], env.ld1
+            env.ws_rows(src, 2 * I), env.vin.at[:, pl.ds(0, 2 * I)],
+            env.ld1,
         )
-        cp_in.start()
-        _maybe_prefetch(env, args[6], args[7])
-        cp_in.wait()
-        y = _silu_f32(env.vin[:, :I].astype(jnp.float32),
-                      env.vin[:, I:2 * I].astype(jnp.float32))
+
+        @pl.when(fwd_in == 0)
+        def _load():
+            cp_in.start()
+
+        _maybe_prefetch(env, *_pf_args(args))
+
+        def _from_ws():
+            cp_in.wait()
+            return env.vin[:, :2 * I]
+
+        raw = jax.lax.cond(fwd_in == 1, lambda: env.vout[:, :2 * I],
+                           _from_ws)
+        y = _silu_f32(raw[:, :I].astype(jnp.float32),
+                      raw[:, I:2 * I].astype(jnp.float32))
+        _drain_late(env, args)
         env.vout[:, :I] = y.astype(env.dtype)
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, I)], env.ws_rows(dst, I), env.st
         )
-        st.start()
-        st.wait()
+        _finish_store(env, st, args)
 
     body.handles_prefetch = True
     return body
@@ -369,15 +480,15 @@ def _add_branch(key, env: _Env):
         )
         cp_a.start()
         cp_b.start()
-        _maybe_prefetch(env, args[6], args[7])
+        _maybe_prefetch(env, *_pf_args(args))
         cp_a.wait()
         cp_b.wait()
+        _drain_late(env, args)
         env.vout[:, :W] = env.vin[:, :W] + env.vin2[:env.pb, :W]
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
         )
-        st.start()
-        st.wait()
+        _finish_store(env, st, args)
 
     body.handles_prefetch = True
     return body
@@ -389,7 +500,7 @@ def _barrier_branch(key, env: _Env):
     def body(args):
         # the pf DMA reads only local weights: issue it before waiting
         # for the slowest rank, not after
-        _maybe_prefetch(env, args[6], args[7])
+        _maybe_prefetch(env, *_pf_args(args))
         shmem.barrier_all(axis)
 
     body.handles_prefetch = True
@@ -404,6 +515,7 @@ def _allreduce_add_branch(key, env: _Env):
 
     def body(args):
         src, res, dst, parity = args[0], args[1], args[2], args[3]
+        fwd_in = args[13]
         pb = env.pb
         cp_res = pltpu.make_async_copy(
             env.ws_rows(res, W),
@@ -418,7 +530,7 @@ def _allreduce_add_branch(key, env: _Env):
                 env.ld1,
             )
             cp_loc.start()
-            _maybe_prefetch(env, args[6], args[7])
+            _maybe_prefetch(env, *_pf_args(args))
 
             def skew():
                 # race provocation (tests only): stall the straggler
@@ -434,7 +546,10 @@ def _allreduce_add_branch(key, env: _Env):
                 # churn: semaphore churn is unusable in a multi-core
                 # kernel (signal and wait can land on different cores'
                 # semaphore instances); a copy start/wait pair is the
-                # per-core pattern every branch already relies on.
+                # per-core pattern every branch already relies on. The
+                # churn runs on its own scratch semaphore (chsem): on
+                # ld1 its waits could consume cp_loc's identical-byte
+                # completion while cp_loc is still in flight.
                 # Native uses cycle-accurate pl.delay.
                 s_rank, s_ns = env.straggler
                 if s_ns <= 0:
@@ -447,7 +562,7 @@ def _allreduce_add_branch(key, env: _Env):
                         def churn(_, c):
                             cp = pltpu.make_async_copy(
                                 env.ws_rows(src, W),
-                                env.vin.at[:, pl.ds(0, W)], env.ld1,
+                                env.vin.at[:, pl.ds(0, W)], env.chsem,
                             )
                             cp.start()
                             cp.wait()
@@ -484,18 +599,28 @@ def _allreduce_add_branch(key, env: _Env):
             cp_loc = pltpu.make_async_copy(
                 env.ws_rows(src, W), env.vin.at[:, pl.ds(0, W)], env.ld1
             )
-            cp_loc.start()
-            _maybe_prefetch(env, args[6], args[7])
-            cp_loc.wait()
-            acc = env.vin[:, :W].astype(jnp.float32)
+
+            @pl.when(fwd_in == 0)
+            def _load():
+                cp_loc.start()
+
+            _maybe_prefetch(env, *_pf_args(args))
+
+            def _from_ws():
+                cp_loc.wait()
+                return env.vin[:, :W]
+
+            acc = jax.lax.cond(
+                fwd_in == 1, lambda: env.vout[:, :W], _from_ws
+            ).astype(jnp.float32)
         cp_res.wait()
         acc = acc + env.vin2[:env.pb, :W].astype(jnp.float32)
+        _drain_late(env, args)
         env.vout[:, :W] = acc.astype(env.dtype)
         st = pltpu.make_async_copy(
             env.vout.at[:, pl.ds(0, W)], env.ws_rows(dst, W), env.st
         )
-        st.start()
-        st.wait()
+        _finish_store(env, st, args)
 
     body.handles_prefetch = True
     return body
@@ -550,10 +675,15 @@ def _attention_branch(key, env: _Env):
         layer, src, dst, kn_dst, vn_dst = (
             args[0], args[1], args[2], args[3], args[4]
         )
+        fwd_in = args[13]
         cp_in = pltpu.make_async_copy(
             env.ws_rows(src, WQKV), env.vin.at[:, pl.ds(0, WQKV)], env.ld1
         )
-        cp_in.start()
+
+        @pl.when(fwd_in == 0)
+        def _load():
+            cp_in.start()
+
         if use_qk_norm:
             cp_qn = pltpu.make_async_copy(
                 env.norms.at[pl.ds((q_base + layer) * 8, 8)], env.vnq,
@@ -574,7 +704,15 @@ def _attention_branch(key, env: _Env):
             )
             cp.start()
             rope_cps.append(cp)
-        cp_in.wait()
+        def _from_ws():
+            cp_in.wait()
+            return env.vin[:, :WQKV]
+
+        # fwd_in: the qkv matmul immediately precedes on this queue and
+        # its result still sits in vout — read it there, skip the HBM
+        # round trip (its deferred store only READS vout: safe)
+        raw_qkv = jax.lax.cond(fwd_in == 1, lambda: env.vout[:, :WQKV],
+                               _from_ws)
         if use_qk_norm:
             cp_qn.wait()
             cp_kn.wait()
@@ -583,7 +721,7 @@ def _attention_branch(key, env: _Env):
 
         # full-PB loads/stores only: Mosaic rejects sub-sublane ref slices;
         # value-level slicing to the B live rows is free vreg selection
-        qkv_full = env.vin[:, :WQKV].astype(jnp.float32)
+        qkv_full = raw_qkv.astype(jnp.float32)
         qkv = qkv_full[:B]
         q = qkv[:, :hqd].reshape(B, hq_l, D)
         kn = qkv[:, hqd:hqd + kw].reshape(B, hkv_l, D)
@@ -605,6 +743,9 @@ def _attention_branch(key, env: _Env):
                 [v, jnp.zeros((pb - v.shape[0], v.shape[1]), v.dtype)], 0
             )
 
+        # about to overwrite vout (a deferred store's source; raw_qkv is
+        # already materialized in registers above)
+        _drain_late(env, args)
         # stage: [0,hqdp) attention out · then k_new · then v_new
         env.vout[:, hqdp:hqdp + kw] = pad_rows(
             kn.reshape(B, kw).astype(env.dtype))
@@ -688,7 +829,7 @@ def _attention_branch(key, env: _Env):
                 # static path (whole cache is one page; bench shapes)
                 kv_start(h, 0, 0)
                 if h == hkv_l - 1:
-                    _maybe_prefetch(env, args[6], args[7])
+                    _maybe_prefetch(env, *_pf_args(args))
                 kv_wait(0)
                 state = chunk_update(h, 0, state)
             else:
@@ -704,7 +845,7 @@ def _attention_branch(key, env: _Env):
                     kv_start(h, 0, 0)
 
                 if h == hkv_l - 1:
-                    _maybe_prefetch(env, args[6], args[7])
+                    _maybe_prefetch(env, *_pf_args(args))
 
                 def loop_body(ci, state):
                     @pl.when(ci + 1 < n_act)
@@ -757,7 +898,7 @@ def _noop_branch(key, env: _Env):
     dispatch wrapper) and queue padding execute this empty body."""
 
     def body(args):
-        _maybe_prefetch(env, args[6], args[7])
+        _maybe_prefetch(env, *_pf_args(args))
 
     body.handles_prefetch = True
     return body
@@ -826,43 +967,64 @@ def compile_graph(
         branch_of[("noop",)] = len(branch_keys)
         branch_keys.append(("noop",))
 
+    # weight-streaming plan (scheduler.plan_prefetch): pf_specs is the
+    # arena geometry, the per-task issue/consume arrays fill row columns
+    # 7-10. Schedules produced by schedule_graph carry the plan; bare
+    # Schedules (tests) get one planned here.
+    pf_plan = sched.prefetch
+    if pf_plan is None:
+        pf_plan = plan_prefetch(graph, sched, depth=default_pf_depth())
+    pf_specs = pf_plan.specs
+    pf_depth = pf_plan.depth
+
+    # store/forward plan (single-core only; see scheduler.StorePlan).
+    # Per-branch capabilities live here because only the kernel knows
+    # each branch body's structure.
+    def _store_caps(t):
+        """(deferrable store width, can_late_drain, fwd_spec)."""
+        k = t.branch_key
+        if k[0] == "matmul":
+            in_w = 2 * k[2] if k[4] == "silu" else k[2]
+            return k[3], True, (t.reads[0], in_w)
+        if k[0] == "rms_norm":
+            return k[1], True, (t.reads[0], k[1])
+        if k[0] == "silu_mul":
+            return k[1], True, (t.reads[0], 2 * k[1])
+        if k[0] == "add":
+            return k[1], True, None  # two-input body: no single forward
+        if k[0] == "allreduce_add":
+            # n>1 publishes src to the mailbox — must come from HBM
+            fwd = (t.reads[0], k[1]) if k[3] == 1 else None
+            return k[1], True, fwd
+        if k[0] == "attention":
+            # multi-store epilogue cannot defer, but the body can both
+            # late-drain and read its qkv input straight from vout
+            wqkv = (k[1] + 2 * k[2]) * k[3]
+            return 0, True, (t.reads[0], wqkv)
+        return 0, False, None  # barrier
+
+    caps = [_store_caps(t) for t in tasks]
+    st_plan = plan_store_forward(
+        graph, sched,
+        [c[0] for c in caps], [c[1] for c in caps], [c[2] for c in caps],
+    )
+    store_widths = st_plan.widths
+
     def base_row(t):
         row = [branch_of[t.branch_key]] + list(t.args)
         row += [0] * (ROW - len(row))
         for pos_ in t.buf_args:
             row[1 + pos_] = int(sched.buf_slot[row[1 + pos_]])
+        tid = t.id
+        row[7] = int(pf_plan.issue_code[tid])
+        row[8] = int(pf_plan.issue_layer[tid])
+        row[9] = int(pf_plan.issue_slot[tid])
+        row[10] = int(pf_plan.consume[tid])
+        row[11] = int(st_plan.pend_w[tid])
+        row[12] = int(st_plan.pend_early[tid])
+        row[13] = int(st_plan.defer_st[tid])
+        row[14] = int(st_plan.fwd_in[tid])
         return row[:ROW]
-
-    # cross-task weight prefetch hints (see ROW comment): a weight is
-    # prefetchable only when every matmul using it shares one (K, TN)
-    mm_keys_all = [t.branch_key for t in tasks if t.op == "matmul"]
-    name_dims: Dict[str, set] = {}
-    for k in mm_keys_all:
-        name_dims.setdefault(k[1], set()).add((k[2], _fit_tile(k[3])))
-    pf_specs = []
-    pf_code_of = {}
-    for wname in sorted(name_dims):
-        if len(name_dims[wname]) == 1:
-            (kk, tn), = name_dims[wname]
-            pf_code_of[wname] = len(pf_specs) + 1
-            pf_specs.append((wname, kk, tn))
-
-    def assign_pf_hints(q2d, tids):
-        # The pf hint rides the immediately preceding task's row IN THE
-        # SAME QUEUE (vpf is per-core VMEM: hint and consumer must share
-        # a core). (Assigning it to the closest previous MATMUL instead —
-        # so the tile streams through intervening small tasks — was
-        # measured WORSE on the 32B model: the 3-5 MB pf tile
-        # head-of-line-blocks every intervening task's small input DMA in
-        # the shared HBM->VMEM queue. What helps is issuing EARLY WITHIN
-        # the task, after its own loads are queued — see the branch
-        # bodies.)
-        for qi in range(len(tids) - 1):
-            nxt = tasks[tids[qi + 1]]
-            if nxt.op == "matmul" and nxt.branch_key[1] in pf_code_of:
-                q2d[qi, 7] = pf_code_of[nxt.branch_key[1]]
-                q2d[qi, 8] = nxt.args[0]  # layer
-                q2d[qi + 1, 9] = 1        # consumer: first tile prefetched
 
     order = sched.order
     if nc == 1:
@@ -870,7 +1032,6 @@ def compile_graph(
         queue = np.zeros((len(order), ROW), np.int32)
         for qi, tid in enumerate(order):
             queue[qi] = base_row(tasks[tid])
-        assign_pf_hints(queue, order)
         qmax = len(order)
     else:
         # per-core queues + scoreboard plan. Queue identity (program_id 0)
@@ -906,7 +1067,6 @@ def compile_graph(
             queue[c, qlens[c]] = dr
             for p in range(qlens[c] + 1, qmax):
                 queue[c, p] = noop_row
-            assign_pf_hints(queue[c], qtasks)
 
     # static dims
     wmax = round_up(max(b.width for b in graph.buffers), 128)
@@ -945,7 +1105,7 @@ def compile_graph(
     n_slots = sched.n_slots
     isz = jnp.dtype(dtype).itemsize
     vmem = (
-        pf_kmax * pf_tnmax * isz +
+        pf_depth * pf_kmax * pf_tnmax * isz +
         4 * PB * wmax * max(isz, 4)
         + 2 * kmax * tnmax * isz
         + min(2, SMAX // SCHUNK) * 2 * B * SCHUNK * D * isz
@@ -963,7 +1123,8 @@ def compile_graph(
         (norms, rope_cs, k_cache, v_cache,
          ws_out,
          vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
-         ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem) = tail
+         ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem,
+         chsem) = tail
         del ws_in  # aliased: access via the output ref
         env = _Env(
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
@@ -972,7 +1133,8 @@ def compile_graph(
             norms=norms, rope_cs=rope_cs, k_cache=k_cache,
             v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
             vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, vpf=vpf,
-            pfsem=pfsem, pf_specs=pf_specs, mailbox=mailbox,
+            pfsem=pfsem, pf_specs=pf_specs, pf_depth=pf_depth,
+            store_widths=store_widths, chsem=chsem, mailbox=mailbox,
             ld1=ld1, ld2=ld2,
             st=st, wsems=wsems, kvsem=kvsem, kvsems=kvsems, send=send,
             recv=recv,
@@ -1003,9 +1165,16 @@ def compile_graph(
                     pltpu.semaphore_wait(sb.at[c2], delta)
 
         def dispatch(f):
+            # pend_early=1: the previous row's deferred store must land
+            # before this task's loads (its reads alias the stored slot,
+            # or the branch has no late-drain site)
+            @pl.when(jnp.logical_and(a[10] > 0, a[11] == 1))
+            def _early_drain():
+                _drain_pending(env, a[10])
+
             f(a)
             if not getattr(f, "handles_prefetch", False):
-                _maybe_prefetch(env, a[6], a[7])
+                _maybe_prefetch(env, a[6], a[7], a[8])
 
         jax.lax.switch(row(0), [lambda f=f: dispatch(f) for f in bodies])
 
@@ -1048,7 +1217,8 @@ def compile_graph(
                 # f32 8-row stripes (see _rms_norm_branch)
                 pltpu.VMEM((8, norm_width), jnp.float32),  # vnq
                 pltpu.VMEM((8, norm_width), jnp.float32),  # vnk
-                pltpu.VMEM((pf_kmax, pf_tnmax), dtype),  # vpf prefetch
+                pltpu.VMEM((pf_depth, pf_kmax, pf_tnmax),  # vpf arena
+                           dtype),
                 pltpu.VMEM((2, world, PB, arw), dtype),  # AR mailbox
                 pltpu.SemaphoreType.DMA,                 # ld1
                 pltpu.SemaphoreType.DMA,                 # ld2
@@ -1059,7 +1229,8 @@ def compile_graph(
                     (min(2, SMAX // SCHUNK),)),
                 pltpu.SemaphoreType.DMA,                 # send
                 pltpu.SemaphoreType.DMA((2,)),           # recv (per-parity)
-                pltpu.SemaphoreType.DMA,                 # pfsem
+                pltpu.SemaphoreType.DMA((pf_depth,)),    # pfsem (per-slot)
+                pltpu.SemaphoreType.DMA,                 # chsem (AR churn)
             ] + (
                 # multi-core scoreboard: sb[c] counts queue c completions
                 [pltpu.SemaphoreType.REGULAR((nc,))] if nc > 1 else []
@@ -1070,7 +1241,9 @@ def compile_graph(
             from triton_dist_tpu.lang.core import use_interpret
 
             if use_interpret():
-                extra["interpret"] = pltpu.InterpretParams(
+                from triton_dist_tpu.lang.core import interpret_params
+
+                extra["interpret"] = interpret_params(
                     num_cores_or_threads=nc,
                     detect_races=os.environ.get("TDT_MEGA_RACES") == "1",
                 )

@@ -97,25 +97,41 @@ class PagedMegaKVCache(NamedTuple):
     def from_dense(cache, page: int, total_pages: int,
                    max_pages: int) -> "PagedMegaKVCache":
         """Page an Engine prefill cache (L, B, T, Hkv, D): each
-        sequence's prefix claims ceil(len/page) consecutive pool pages.
-        Prefill lengths are uniform here (Engine pads to T), so the page
-        walk is a static reshape + sequential table."""
+        sequence's VALID prefix (cache.length, not the cache's full
+        allocated T — Engine allocates at max_len) claims
+        ceil(len/page) consecutive pool pages, so
+        next_free == sum_b ceil(len_b / page) and ragged batches share
+        the pool. Runs outside jit: lengths are concrete, and the page
+        walk is a host-built gather over the cache's page grid."""
         L, B, T, Hkv, D = cache.k.shape
-        assert T % page == 0, f"prefill len {T} % page {page}"
-        used = B * (T // page)
+        assert T % page == 0, f"cache len {T} % page {page}"
+        lengths = np.asarray(cache.length)
+        pages_per = -(-lengths // page)  # ceil
+        used = int(pages_per.sum())
         assert used <= total_pages, "pool too small for the prefill"
-        k = jnp.moveaxis(cache.k, 3, 1).reshape(L, Hkv, B * (T // page),
-                                                page, D)
-        v = jnp.moveaxis(cache.v, 3, 1).reshape(L, Hkv, B * (T // page),
-                                                page, D)
+        assert int(pages_per.max(initial=0)) <= max_pages, (
+            "prefill longer than the table's max_pages"
+        )
+        # (seq, page-in-seq) of each claimed pool page, in claim order
+        src_b = np.repeat(np.arange(B), pages_per)
+        src_p = np.concatenate(
+            [np.arange(p) for p in pages_per]
+        ).astype(np.int64) if used else np.zeros((0,), np.int64)
+        grid = jnp.moveaxis(cache.k, 3, 1).reshape(
+            L, Hkv, B, T // page, page, D)
+        gridv = jnp.moveaxis(cache.v, 3, 1).reshape(
+            L, Hkv, B, T // page, page, D)
+        k = grid[:, :, src_b, src_p]          # (L, Hkv, used, page, D)
+        v = gridv[:, :, src_b, src_p]
         pad = total_pages - used
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-        table = jnp.zeros((B, max_pages), jnp.int32)
-        ids = jnp.arange(B * (T // page), dtype=jnp.int32).reshape(
-            B, T // page)
-        table = table.at[:, :T // page].set(ids)
-        return PagedMegaKVCache(k, v, table, cache.length,
+        table = np.zeros((B, max_pages), np.int32)
+        off = 0
+        for b in range(B):
+            table[b, :pages_per[b]] = np.arange(off, off + pages_per[b])
+            off += int(pages_per[b])
+        return PagedMegaKVCache(k, v, jnp.asarray(table), cache.length,
                                 jnp.asarray(used, jnp.int32))
 
 

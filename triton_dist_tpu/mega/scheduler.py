@@ -22,15 +22,60 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import os
 import heapq
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from triton_dist_tpu.mega import _native
-from triton_dist_tpu.mega.core import Graph
+from triton_dist_tpu.mega.core import Graph, fit_mm_tile
 
 STRATEGIES = {"round_robin": 0, "blocked": 1, "least_loaded": 2}
+
+
+def default_pf_depth() -> int:
+    """Weight-prefetch arena depth (rotating VMEM slots). 2 keeps one
+    tile in flight across every task boundary; TDT_MEGA_PF_DEPTH
+    overrides (1 restores the legacy single-tile lookahead)."""
+    return max(1, int(os.environ.get("TDT_MEGA_PF_DEPTH", "2")))
+
+
+@dataclasses.dataclass
+class PrefetchPlan:
+    """The cross-task weight-streaming plan (see kernel.py ROW comment):
+    each prefetchable matmul ("consumer") is assigned a rotating arena
+    slot and an earlier row of the SAME queue ("issuer") that starts the
+    first weight tile's DMA. depth = arena slots = max prefetches in
+    flight. Consumers with no legal issuer open cold and are recorded in
+    `cold` — validate_schedule enforces that every consumer is exactly
+    one of the two."""
+
+    depth: int
+    specs: List[Tuple[str, int, int]]   # [(wname, K, TN)] — pf_code order
+    issue_code: np.ndarray              # (n_tasks,) 0 = row carries no hint
+    issue_layer: np.ndarray
+    issue_slot: np.ndarray
+    consume: np.ndarray                 # (n_tasks,) pf_in: slot+1, 0 = cold
+    cold: List[int]                     # consumer task ids opening cold
+
+
+@dataclasses.dataclass
+class StorePlan:
+    """The cross-task store/forward pipeline (single-core queues only —
+    under concurrent cores a scoreboard completion must imply the data is
+    in HBM, which a deferred store would break). defer_st=1 rows leave
+    their workspace store in flight; the FOLLOWING row drains it (pend_w
+    = 1 + index into `widths`), before its own loads when pend_early=1
+    (reads alias the stored slot, or the branch has no late-drain site)
+    or right before it first overwrites vout otherwise. fwd_in=1 rows
+    read their main input straight from the previous task's vout."""
+
+    widths: Tuple[int, ...]
+    defer_st: np.ndarray
+    pend_w: np.ndarray
+    pend_early: np.ndarray
+    fwd_in: np.ndarray
 
 
 @dataclasses.dataclass
@@ -43,6 +88,12 @@ class Schedule:
     buf_slot: np.ndarray     # (n_bufs,) workspace slot per buffer
     n_slots: int
     native: bool             # True when produced by the C++ scheduler
+    # predicted scoreboard stall per queue (cost-model time a core spends
+    # waiting on other cores' watermarks beyond its own availability),
+    # from predicted_stalls; validate_schedule asserts monotonized
+    # watermarks reproduce it exactly
+    stall: Any = None
+    prefetch: Optional[PrefetchPlan] = None
 
     @property
     def num_cores(self) -> int:
@@ -56,11 +107,20 @@ def _i32(a) -> np.ndarray:
 # -- pure-Python mirrors of the native algorithms ----------------------------
 
 
-def _py_schedule(n, edges, cost, num_cores, strategy):
+def _py_schedule(n, edges, cost, num_cores, strategy, affinity=None):
+    """affinity (optional, least_loaded only): per-task bool marking
+    prefetch consumers (matmuls whose first weight tile can stream from
+    an earlier row of the same queue — kernel.py ROW comment). Such a
+    task prefers the core of its latest-scheduled predecessor among
+    near-tied loads, so a branch able to ISSUE its prefetch precedes it
+    in the same queue (the hint and the arena are per-core VMEM: a
+    cross-core predecessor cannot feed it)."""
     succ = [[] for _ in range(n)]
+    pred = [[] for _ in range(n)]
     indeg = [0] * n
     for s, d in edges:
         succ[s].append(d)
+        pred[d].append(s)
         indeg[d] += 1
     # critical-path priorities over reverse topo order
     order = []
@@ -75,16 +135,19 @@ def _py_schedule(n, edges, cost, num_cores, strategy):
                 stack.append(s)
     if len(order) != n:
         raise ValueError("dependency cycle in megakernel graph")
+    def cost_of(t):
+        return cost[t] if cost is not None else 1.0
+
     prio = [0.0] * n
     for t in reversed(order):
-        c = cost[t] if cost is not None else 1.0
-        prio[t] = c + max((prio[s] for s in succ[t]), default=0.0)
+        prio[t] = cost_of(t) + max((prio[s] for s in succ[t]), default=0.0)
 
     ready = [(-prio[t], t) for t in range(n) if indeg[t] == 0]
     heapq.heapify(ready)
     deg = list(indeg)
     core = [0] * n
     pos = [0] * n
+    sched_at = [0] * n  # scheduling step, for the affinity tie-break
     core_load = [0.0] * num_cores
     core_len = [0] * num_cores
     scheduled = 0
@@ -101,10 +164,19 @@ def _py_schedule(n, edges, cost, num_cores, strategy):
             c = min(scheduled // per, num_cores - 1)
         else:
             c = min(range(num_cores), key=lambda k: core_load[k])
+            if affinity is not None and affinity[t] and pred[t]:
+                # prefetch co-location: among near-tied cores, follow the
+                # latest-scheduled predecessor (load slack bounded by the
+                # task's own cost — never trades real balance for it)
+                want = core[max(pred[t], key=lambda p: sched_at[p])]
+                if (want != c
+                        and core_load[want] <= core_load[c] + cost_of(t)):
+                    c = want
         core[t] = c
         pos[t] = core_len[c]
         core_len[c] += 1
-        core_load[c] += cost[t] if cost is not None else 1.0
+        core_load[c] += cost_of(t)
+        sched_at[t] = scheduled
         scheduled += 1
         for s in succ[t]:
             deg[s] -= 1
@@ -276,6 +348,286 @@ def _py_plan_slots(ndef, last, pinned):
     return np.array(slot, np.int32), len(free_at)
 
 
+# -- prefetch / store-pipeline planning ---------------------------------------
+
+
+def prefetch_specs(tasks) -> Tuple[List[Tuple[str, int, int]], dict]:
+    """([(wname, K, TN)] in pf_code order, wname -> pf_code). A weight is
+    prefetchable only when every matmul using it shares one (K, TN) —
+    the single arena-tile geometry the issuer and consumer must agree
+    on. Shared by kernel.compile_graph (builds the arena) and
+    plan_prefetch/validate_schedule (assign and check the hints)."""
+    name_dims: dict = {}
+    for t in tasks:
+        if t.op != "matmul":
+            continue
+        k = t.branch_key
+        name_dims.setdefault(k[1], set()).add((k[2], fit_mm_tile(k[3])))
+    specs: List[Tuple[str, int, int]] = []
+    code_of: dict = {}
+    for wname in sorted(name_dims):
+        if len(name_dims[wname]) == 1:
+            (kk, tn), = name_dims[wname]
+            code_of[wname] = len(specs) + 1
+            specs.append((wname, kk, tn))
+    return specs, code_of
+
+
+def _matmul_nt(task) -> int:
+    n_cols = task.branch_key[3]
+    return n_cols // fit_mm_tile(n_cols)
+
+
+def plan_prefetch(graph: Graph, sched: "Schedule",
+                  depth: int = 2) -> PrefetchPlan:
+    """Assign each prefetchable matmul a rotating arena slot and an
+    issuing predecessor row in the same queue.
+
+    Policy: the hint rides the IMMEDIATELY preceding row (assigning it to
+    the closest previous matmul instead — streaming through intervening
+    small tasks — was measured WORSE on the 32B model: the 3-5 MB pf
+    tile head-of-line-blocks every intervening task's small input DMA in
+    the shared HBM->VMEM queue; what helps is issuing EARLY WITHIN the
+    task — see the kernel branch bodies). The arena's job is different:
+    with depth >= 2 an nt==1 matmul can issue the NEXT matmul's tile
+    before its own last dot instead of in its store epilogue, and the
+    slot being written is never the slot being read.
+
+    Slot-safety invariant (replayed in _validate_prefetch): an issue into
+    slot s must come strictly after the previous consumer of s has read
+    it — equality (issue and previous consume on one row) is legal only
+    when that row is a matmul with nt > 1, which reads its own tile at
+    j==0 before issuing at j==nt-1; an nt==1 matmul under depth > 1
+    issues BEFORE its read."""
+    tasks = graph.tasks
+    n = len(tasks)
+    specs, code_of = prefetch_specs(tasks)
+    plan = PrefetchPlan(
+        depth=depth, specs=specs,
+        issue_code=np.zeros(n, np.int32),
+        issue_layer=np.zeros(n, np.int32),
+        issue_slot=np.zeros(n, np.int32),
+        consume=np.zeros(n, np.int32), cold=[],
+    )
+    for q in sched.queues:
+        cons_rows: List[int] = []  # queue rows of slot-using consumers
+        for qi, tid in enumerate(q):
+            t = tasks[tid]
+            if t.op != "matmul" or t.branch_key[1] not in code_of:
+                continue
+            k = len(cons_rows)
+            lo = cons_rows[k - depth] if k >= depth else -1
+            isr = qi - 1
+            ok = isr >= 0 and plan.issue_code[q[isr]] == 0
+            if ok and isr == lo:
+                # issuer row IS the slot's previous consumer: only safe
+                # when it reads its own tile before issuing (nt > 1)
+                prev = tasks[q[isr]]
+                ok = prev.op == "matmul" and _matmul_nt(prev) > 1
+            elif ok:
+                ok = isr > lo
+            if not ok:
+                plan.cold.append(tid)
+                continue
+            slot = k % depth
+            plan.issue_code[q[isr]] = code_of[t.branch_key[1]]
+            plan.issue_layer[q[isr]] = t.args[0]
+            plan.issue_slot[q[isr]] = slot
+            plan.consume[tid] = slot + 1
+            cons_rows.append(qi)
+    _validate_prefetch(graph, sched, plan)  # self-check at plan time
+    return plan
+
+
+def _validate_prefetch(graph: Graph, sched: "Schedule",
+                       plan: PrefetchPlan) -> None:
+    """Replay the arena per queue: every issue targets a drained slot,
+    every consume finds its slot filled with the matching weight tile,
+    and every prefetchable matmul either consumes or is flagged cold."""
+    tasks = graph.tasks
+    specs, code_of = prefetch_specs(tasks)
+    assert plan.specs == specs, "prefetch plan built for a different graph"
+    cold = set(plan.cold)
+    seen = set()
+    for q in sched.queues:
+        filled: dict = {}  # slot -> (pf_code, layer)
+        for qi, tid in enumerate(q):
+            t = tasks[tid]
+            is_consumer = (t.op == "matmul"
+                           and t.branch_key[1] in code_of)
+            code = int(plan.issue_code[tid])
+            cons = int(plan.consume[tid])
+            if not is_consumer:
+                assert cons == 0, (
+                    f"non-matmul task {tid} marked as prefetch consumer")
+            # same-row ordering: nt>1 matmuls consume then issue;
+            # everything else (incl. nt==1 under depth>1) issues first
+            consume_first = (is_consumer and cons > 0
+                             and _matmul_nt(t) > 1)
+
+            def do_consume():
+                slot = cons - 1
+                assert slot in filled, (
+                    f"task {tid} consumes arena slot {slot} but no "
+                    "prefetch is in flight there")
+                got_code, got_layer = filled.pop(slot)
+                assert got_code == code_of[t.branch_key[1]], (
+                    f"task {tid}: arena slot {slot} holds weight code "
+                    f"{got_code}, expected {code_of[t.branch_key[1]]}")
+                assert got_layer == t.args[0], (
+                    f"task {tid}: arena slot {slot} holds layer "
+                    f"{got_layer}, expected {t.args[0]}")
+
+            if consume_first:
+                do_consume()
+            if code:
+                slot = int(plan.issue_slot[tid])
+                assert 0 <= slot < plan.depth
+                assert slot not in filled, (
+                    f"task {tid} issues into arena slot {slot} while the "
+                    "previous tile there is unconsumed")
+                filled[slot] = (code, int(plan.issue_layer[tid]))
+            if is_consumer:
+                if cons > 0:
+                    if not consume_first:
+                        do_consume()
+                    seen.add(tid)
+                else:
+                    assert tid in cold, (
+                        f"matmul task {tid} ({t.tag}) has no issuing "
+                        "predecessor and is not flagged cold")
+                    seen.add(tid)
+        assert not filled, (
+            f"prefetches left in flight at queue end: {filled}")
+    # coverage: every prefetchable matmul is either fed or flagged cold
+    for t in tasks:
+        if t.op == "matmul" and t.branch_key[1] in code_of:
+            assert t.id in seen
+    assert cold.isdisjoint(
+        {t for t in range(len(tasks)) if plan.consume[t] > 0})
+
+
+def plan_store_forward(
+    graph: Graph,
+    sched: "Schedule",
+    store_width,
+    can_late_drain,
+    fwd_spec,
+) -> StorePlan:
+    """Build the deferred-store / forward plan for a single-core queue.
+
+    store_width[t]: width of task t's deferrable workspace store (0 =
+    the branch cannot defer: attention's multi-store epilogue, barrier).
+    can_late_drain[t]: the branch drains a pending store right before
+    overwriting vout (matmul/rms/silu/add/AR); others must drain EARLY,
+    in the dispatch wrapper, before their loads. fwd_spec[t]: (main
+    source buffer id, rows read from vout) for branches that can read
+    their input from the previous task's vout, else None."""
+    n = len(graph.tasks)
+    empty = StorePlan((), np.zeros(n, np.int32), np.zeros(n, np.int32),
+                      np.zeros(n, np.int32), np.zeros(n, np.int32))
+    if sched.num_cores != 1:
+        # concurrent queues: a scoreboard completion must imply the data
+        # reached HBM — never defer across the scoreboard
+        return empty
+    q = sched.queues[0]
+    tasks = graph.tasks
+    pairs = []  # (producer, consumer, width, early, fwd)
+    for qi in range(len(q) - 1):
+        p, c = q[qi], q[qi + 1]
+        w = int(store_width[p])
+        if w == 0:
+            continue
+        tp, tc = tasks[p], tasks[c]
+        assert len(tp.writes) == 1, (
+            f"deferrable task {p} must write exactly one buffer")
+        dst = tp.writes[0]
+        fs = fwd_spec[c]
+        fwd = (fs is not None and fs[0] == dst and fs[1] <= w
+               # reads of dst beyond the main source still hit HBM and
+               # would need the store drained first — no forward then
+               and tc.reads.count(dst) == 1)
+        if fwd:
+            assert can_late_drain[c], "forward-capable branches late-drain"
+            early = 0
+        elif dst in tc.reads:
+            early = 1  # consumer loads the stored slot from HBM
+        else:
+            early = 0 if can_late_drain[c] else 1
+        pairs.append((p, c, w, early, 1 if fwd else 0))
+    if not pairs:
+        return empty
+    widths = tuple(sorted({w for _, _, w, _, _ in pairs}))
+    plan = StorePlan(widths, np.zeros(n, np.int32), np.zeros(n, np.int32),
+                     np.zeros(n, np.int32), np.zeros(n, np.int32))
+    for p, c, w, early, fwd in pairs:
+        plan.defer_st[p] = 1
+        plan.pend_w[c] = widths.index(w) + 1
+        plan.pend_early[c] = early
+        plan.fwd_in[c] = fwd
+    return plan
+
+
+# -- predicted scoreboard stall ----------------------------------------------
+
+
+def predicted_stalls(graph: Graph, sched: "Schedule",
+                     monotone: bool = False) -> np.ndarray:
+    """Cost-model simulation of the multi-queue execution: each core runs
+    its queue in order; a task starts at max(own core free, dep ends).
+    Returns per-core stall = total time a core sits waiting on OTHER
+    cores' watermarks beyond its own availability.
+
+    monotone=True derives deps from the monotonized watermarks the
+    kernel actually waits on (task t waits for task (c, wm_mono[t,c]-1))
+    instead of the raw graph edges; validate_schedule asserts both give
+    identical stalls — the monotone rewrite's no-extra-blocking theorem
+    (see monotone_watermarks)."""
+    tasks = graph.tasks
+    n = len(tasks)
+    nc = sched.num_cores
+    core = np.asarray(sched.core)
+    deps: List[List[int]] = [[] for _ in range(n)]
+    if monotone:
+        wm = monotone_watermarks(sched)
+        by_cp = {(int(core[t]), int(sched.pos[t])): t for t in range(n)}
+        for t in range(n):
+            for c in range(nc):
+                w = int(wm[t, c])
+                if w > 0 and c != core[t]:
+                    deps[t].append(by_cp[(c, w - 1)])
+    else:
+        for s, d in graph.edges:
+            if core[s] != core[d]:
+                deps[d].append(s)
+    ptr = [0] * nc
+    t_end = [None] * n
+    core_time = [0.0] * nc
+    stall = np.zeros(nc, np.float64)
+    done = 0
+    while done < n:
+        best = None
+        for c in range(nc):
+            if ptr[c] >= len(sched.queues[c]):
+                continue
+            t = sched.queues[c][ptr[c]]
+            if any(t_end[d] is None for d in deps[t]):
+                continue
+            start = max([core_time[c]] + [t_end[d] for d in deps[t]])
+            if best is None or start < best[0]:
+                best = (start, c, t)
+        if best is None:
+            raise ValueError("schedule simulation deadlocked "
+                             "(inconsistent watermarks?)")
+        start, c, t = best
+        stall[c] += start - core_time[c]
+        t_end[t] = start + tasks[t].cost
+        core_time[c] = t_end[t]
+        ptr[c] += 1
+        done += 1
+    return stall
+
+
 # -- public entry -------------------------------------------------------------
 
 
@@ -284,17 +636,30 @@ def schedule_graph(
     num_cores: int = 1,
     strategy: str = "least_loaded",
     use_native: Optional[bool] = None,
+    pf_depth: Optional[int] = None,
 ) -> Schedule:
-    """Schedule + plan a Graph. use_native=None auto-selects the C++ lib."""
+    """Schedule + plan a Graph. use_native=None auto-selects the C++ lib.
+
+    pf_depth sets the weight-prefetch arena depth the plan is built for
+    (default: TDT_MEGA_PF_DEPTH env or 2); the returned schedule carries
+    `prefetch` (PrefetchPlan) and `stall` (predicted per-queue scoreboard
+    stall), both asserted by validate_schedule."""
     n = len(graph.tasks)
     if n == 0:
         raise ValueError("empty megakernel graph")
+    if pf_depth is None:
+        pf_depth = default_pf_depth()
     strat = STRATEGIES[strategy]
     edges = graph.edges
     cost = [t.cost for t in graph.tasks]
     lib = _native.load() if use_native in (None, True) else None
     if use_native is True and lib is None:
         raise RuntimeError("native scheduler requested but unavailable")
+
+    def _finalize(sched: Schedule) -> Schedule:
+        sched.stall = predicted_stalls(graph, sched)
+        sched.prefetch = plan_prefetch(graph, sched, depth=pf_depth)
+        return sched
 
     if lib is not None:
         src = _i32([e[0] for e in edges])
@@ -327,7 +692,14 @@ def schedule_graph(
         if rc != 0:
             raise ValueError(f"native watermarks failed rc={rc}")
     else:
-        core, pos = _py_schedule(n, edges, cost, num_cores, strat)
+        # prefetch-aware placement (pure-Python path): a prefetchable
+        # matmul prefers its predecessor's core so the issuing row and
+        # the consuming matmul share a queue (and a VMEM arena)
+        _, code_of = prefetch_specs(graph.tasks)
+        affinity = [t.op == "matmul" and t.branch_key[1] in code_of
+                    for t in graph.tasks]
+        core, pos = _py_schedule(n, edges, cost, num_cores, strat,
+                                 affinity=affinity)
         wm = _py_watermarks(n, edges, core, pos, num_cores)
 
     queues: List[List[int]] = [[] for _ in range(num_cores)]
@@ -349,7 +721,7 @@ def schedule_graph(
             graph, sched, after_vectors(sched, monotone_watermarks(sched)))
         sched.buf_slot = slot
         sched.n_slots = int(n_slots)
-        return sched
+        return _finalize(sched)
 
     ndef, last = graph.liveness(order)
     pinned = [graph.pinned.get(b.id, False) for b in graph.buffers]
@@ -368,22 +740,44 @@ def schedule_graph(
     else:
         slot, n_slots = _py_plan_slots(ndef, last, pinned)
 
-    return Schedule(core=np.asarray(core), pos=np.asarray(pos),
-                    watermarks=wm, order=order, queues=queues,
-                    buf_slot=slot, n_slots=int(n_slots),
-                    native=lib is not None)
+    return _finalize(Schedule(core=np.asarray(core), pos=np.asarray(pos),
+                              watermarks=wm, order=order, queues=queues,
+                              buf_slot=slot, n_slots=int(n_slots),
+                              native=lib is not None))
 
 
 def validate_schedule(graph: Graph, sched: Schedule) -> None:
     """Sanity invariants (tests + compile-time assert): every dep either
     precedes its consumer on the same core or carries a watermark; no two
     buffers sharing a slot can be live concurrently (proved by interval
-    order at one core, by the happens-before closure under many)."""
+    order at one core, by the happens-before closure under many); the
+    prefetch plan covers every prefetchable matmul (fed by an issuing
+    predecessor or explicitly flagged cold) with a race-free arena
+    replay; and the predicted scoreboard stall is reproduced exactly by
+    the monotonized watermarks the kernel actually waits on (the
+    monotone rewrite must add no blocking)."""
     for s, d in graph.edges:
         if sched.core[s] == sched.core[d]:
             assert sched.pos[s] < sched.pos[d], (s, d)
         else:
             assert sched.watermarks[d, sched.core[s]] >= sched.pos[s] + 1
+    # prefetch-coverage invariant (weight-streaming pipeline)
+    plan = sched.prefetch
+    if plan is None:
+        plan = plan_prefetch(graph, sched, depth=default_pf_depth())
+    else:
+        _validate_prefetch(graph, sched, plan)
+    # predicted-stall invariant: raw-edge and monotone-watermark
+    # simulations must agree, and must match the recorded prediction
+    raw = predicted_stalls(graph, sched)
+    mono = predicted_stalls(graph, sched, monotone=True)
+    assert np.allclose(mono, raw), (
+        f"monotone watermark rewrite changes predicted stall: "
+        f"{mono} vs {raw}")
+    if sched.stall is not None:
+        assert np.allclose(np.asarray(sched.stall), raw), (
+            f"recorded stall prediction {sched.stall} does not match "
+            f"the schedule's simulation {raw}")
     if sched.num_cores > 1:
         _validate_slots_hb(graph, sched)
         return

@@ -15,7 +15,6 @@ is to compile the loop itself).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -111,9 +110,26 @@ class Engine:
         self._decode_fn = decode_fn
         self._wrap_specs = (p_specs, t_spec, c_specs)
         self._donate_cache = donate_cache
+        # compiled generate() executables, keyed (steps, greedy). A
+        # per-instance dict, NOT lru_cache on the bound method: that keys
+        # a module-lifetime cache on self and pins every Engine (params +
+        # compiled shard_map executables) for the process lifetime.
+        # Bounded like the lru_cache it replaces — a server honoring
+        # per-request step counts must not accumulate executables forever.
+        self._gen_cache: dict = {}
+        self._gen_cache_max = 8
 
-    @functools.lru_cache(maxsize=8)
     def _gen_fn(self, steps: int, greedy: bool):
+        key = (steps, greedy)
+        fn = self._gen_cache.pop(key, None)
+        if fn is None:
+            fn = self._build_gen_fn(steps, greedy)
+            while len(self._gen_cache) >= self._gen_cache_max:
+                self._gen_cache.pop(next(iter(self._gen_cache)))
+        self._gen_cache[key] = fn  # re-insert = LRU touch
+        return fn
+
+    def _build_gen_fn(self, steps: int, greedy: bool):
         """Compiled multi-step generation: `steps` decode iterations —
         forward, sampling, cache append — inside one lax.fori_loop under
         one jit (one executable replay per GENERATION, not per token)."""

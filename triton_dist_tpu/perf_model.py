@@ -116,6 +116,78 @@ def gemm_arith_intensity(m: int, n: int, k: int, dtype=jnp.bfloat16) -> float:
     return (2.0 * m * n * k) / (b * (m * k + k * n + m * n))
 
 
+# -- Blocked-GEMM tile model (fused-kernel autotuning) ----------------------
+
+# Fixed cost of one Pallas grid step (scalar bookkeeping + pipeline
+# bubble between tiles). Calibrated on the v5e ag_gemm sweeps
+# (benchmark/sweep_ag_gemm.py, round 5): the measured spread between the
+# (256, 3200, 512) winner and narrow-tile losers at fixed HBM traffic is
+# explained by ~0.2-0.4 us per step; the model only needs to RANK
+# configs, so one conservative constant serves every chip generation.
+GRID_STEP_US = 0.3
+
+
+def estimate_blocked_gemm_ms(
+    m: int,
+    n: int,
+    k: int,
+    tile_m: int,
+    tile_n: int,
+    tile_k: int,
+    dtype=jnp.bfloat16,
+    out_dtype=None,
+    chip: Optional[ChipSpec] = None,
+    step_us: float = GRID_STEP_US,
+) -> float:
+    """Tile-aware roofline for a blocked matmul on the (i, j, kk) grid
+    both fused kernels use for their local/forced regimes (kk innermost,
+    j middle): per-tile HBM traffic counts the A-strip re-reads (once per
+    column-tile sweep) and the B re-reads (once per row-tile sweep) that
+    the coarse `estimate_gemm_ms` roofline ignores, plus a fixed
+    per-grid-step overhead — the term that actually separates candidate
+    tile shapes at the benched Qwen3 shapes, where total traffic barely
+    moves but step counts differ 10x.
+
+    Used by the autotuner's prune helpers (autotuner.
+    prune_ag_gemm_configs / prune_gemm_rs_local_configs) to cut the
+    measured config set to the model-plausible frontier; it ranks
+    candidates, it does not promise wall-clock."""
+    chip = chip or detect_chip()
+    b_in = _dtype_bytes(dtype)
+    b_out = _dtype_bytes(out_dtype or dtype)
+    mt = -(-m // tile_m)
+    nt = -(-n // tile_n)
+    nk = -(-k // tile_k)
+    # A block (i, kk) is re-fetched for every j; B block (kk, j) for
+    # every i; C written once.
+    traffic = b_in * (nt * m * k + mt * k * n) + b_out * m * n
+    mem_ms = traffic / (chip.hbm_gbps * 1e9) * 1e3
+    # MXU efficiency is a property of the PROBLEM dims here, not the
+    # tiles: a 256-row tile still feeds the 128x128 systolic array at
+    # full rate inside a long blocked sweep, so scoring tiles with the
+    # short-dim penalty would misrank the measured wide-N winners. Tile
+    # choice enters through traffic and the step count only.
+    compute_ms = (2.0 * m * n * k) / (
+        chip.bf16_tflops * 1e12 * 0.85 * mxu_efficiency(m, n, k)
+    ) * 1e3
+    step_ms = mt * nt * nk * step_us * 1e-3
+    return max(compute_ms, mem_ms) + step_ms
+
+
+def roofline_frontier(configs, model_ms, slack: float = 1.25):
+    """Keep the configs the analytic model places within `slack` of the
+    modeled optimum (the reference folds the same style of pre-filter
+    into its config spaces). model_ms: cfg -> predicted ms; returns the
+    surviving subset, never empty (the best-modeled config always
+    survives)."""
+    configs = list(configs)
+    if not configs:
+        return configs
+    preds = [model_ms(c) for c in configs]
+    best = min(preds)
+    return [c for c, p in zip(configs, preds) if p <= best * slack]
+
+
 # -- Comm models (ref: comm_perf_model.py:94-130) ---------------------------
 
 
