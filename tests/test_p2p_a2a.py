@@ -19,6 +19,7 @@ from triton_dist_tpu.kernels import (
     p2p_read,
     ring_shift,
     all_to_all,
+    all_to_all_chunked,
     all_to_all_ref,
 )
 
@@ -112,3 +113,106 @@ def test_all_to_all_matches_ref(mesh8):
     np.testing.assert_array_equal(
         np.asarray(fused_splits), np.asarray(ref_splits)
     )
+
+
+# ---------- chunked A2A (ISSUE 2: per-chunk delivery semaphores) ----------
+
+
+def _run_a2a(fn, mesh8, x, splits):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh8, in_specs=(P("tp"), P("tp")),
+            out_specs=(P("tp"), P("tp")), check_vma=False,
+        )
+    )(x, splits)
+
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 4])
+def test_all_to_all_chunked_matches_ref(mesh8, n_chunks):
+    """Chunk-granular transport (each capacity chunk on its own delivery
+    semaphore slot) must be byte-identical to the XLA reference, with the
+    2-D metadata rows (the EP pipeline's [count, per-expert counts])
+    travelling alongside."""
+    n, m, h = N_DEV, 4, 128
+    x = jnp.asarray(_make((n * n, m, h), seed=21))
+    rng = np.random.default_rng(5)
+    meta = jnp.asarray(rng.integers(0, m + 1, (n * n, 3)), np.int32)
+
+    out, osp = _run_a2a(
+        functools.partial(all_to_all_chunked, axis="tp",
+                          n_chunks=n_chunks),
+        mesh8, x, meta,
+    )
+    ref_out, ref_sp = _run_a2a(
+        functools.partial(all_to_all_ref, axis="tp"), mesh8, x, meta)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    np.testing.assert_array_equal(np.asarray(osp), np.asarray(ref_sp))
+
+
+@pytest.mark.parametrize("skew_rank", [0, 5])
+def test_all_to_all_chunked_under_skew(mesh8, skew_rank):
+    """Per-rank arrival skew (the AR skew-stress pattern of
+    tests/test_mega_model.py): one rank stalls between entering the
+    kernel and issuing its sends, so every peer's per-chunk waits must
+    really gate on THAT source's chunks — a protocol that assumed
+    lockstep arrival would read stale rows. 1-D splits exercise the
+    classic count-only metadata shape."""
+    n, m, h = N_DEV, 4, 128
+    x = jnp.asarray(_make((n * n, m, h), seed=23))
+    rng = np.random.default_rng(9)
+    splits = jnp.asarray(rng.integers(0, m + 1, (n * n,)), np.int32)
+
+    out, osp = _run_a2a(
+        functools.partial(all_to_all_chunked, axis="tp", n_chunks=2,
+                          straggler=(skew_rank, 200_000)),
+        mesh8, x, splits,
+    )
+    ref_out, ref_sp = _run_a2a(
+        functools.partial(all_to_all_ref, axis="tp"), mesh8, x, splits)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    np.testing.assert_array_equal(np.asarray(osp), np.asarray(ref_sp))
+
+
+def test_all_to_all_chunked_fallback_mode(mesh8, monkeypatch):
+    """The host wrapper's no-headroom fallback (the 'compiled' XLA
+    collective arm — the path a headroom-starved interpret mesh or a
+    driver dryrun takes) must return the same bytes WITHOUT tracing the
+    Pallas protocol kernel."""
+    import sys
+
+    from triton_dist_tpu.lang.core import pallas_call_count
+
+    # the package re-exports the function under the module's name, so
+    # attribute lookup can't reach the module — go through sys.modules
+    a2a_mod = sys.modules["triton_dist_tpu.kernels.all_to_all"]
+
+    n, m, h = N_DEV, 4, 128
+    x = jnp.asarray(_make((n * n, m, h), seed=29))
+    splits = jnp.asarray(
+        np.random.default_rng(2).integers(0, m + 1, (n * n, 2)), np.int32)
+    ref_out, ref_sp = _run_a2a(
+        functools.partial(all_to_all_ref, axis="tp"), mesh8, x, splits)
+
+    monkeypatch.setattr(a2a_mod, "interpret_no_headroom", lambda: True)
+    before = pallas_call_count()
+    out, osp = _run_a2a(
+        functools.partial(all_to_all_chunked, axis="tp", n_chunks=2),
+        mesh8, x, splits,
+    )
+    assert pallas_call_count() == before  # fallback, not the kernel
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    np.testing.assert_array_equal(np.asarray(osp), np.asarray(ref_sp))
+
+
+def test_all_to_all_chunked_rejects_bad_chunking(mesh8):
+    """n_chunks must divide the capacity dim — a silent remainder chunk
+    would ship a short final DMA whose semaphore accounting no longer
+    matches the receive-side waits."""
+    n, m, h = N_DEV, 4, 128
+    x = jnp.asarray(_make((n * n, m, h), seed=31))
+    splits = jnp.zeros((n * n,), jnp.int32)
+    with pytest.raises(ValueError, match="divide"):
+        _run_a2a(
+            functools.partial(all_to_all_chunked, axis="tp", n_chunks=3),
+            mesh8, x, splits,
+        )

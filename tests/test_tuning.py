@@ -135,6 +135,85 @@ def test_prune_gemm_rs_local_configs_respects_vmem():
         assert need <= budget, (c, need)
 
 
+# -- chunk-pipelined EP MoE model (ISSUE 2 tentpole (c)) ---------------------
+
+
+def test_ep_moe_model_pipeline_orderings():
+    """The pipeline roofline must reproduce the chunk-count physics the
+    measured pipeline exhibits: overlap beats sequential at n > 1;
+    chunking pays off on comm-exposed shapes; at n == 1 (no wire time to
+    hide) extra chunks can only lose (weight re-streaming + worse
+    per-chunk MXU efficiency)."""
+    chip = pm.CHIPS["TPU v5 lite"]
+    # comm-heavy: big hidden, tiny expert compute
+    kw = dict(m=128, hidden=7168, inter=256, e_loc=2, top_k=8, chip=chip)
+    seq = pm.estimate_ep_moe_ms(n=8, n_chunks=1, overlap=False, **kw)
+    one = pm.estimate_ep_moe_ms(n=8, n_chunks=1, overlap=True, **kw)
+    four = pm.estimate_ep_moe_ms(n=8, n_chunks=4, overlap=True, **kw)
+    assert one <= seq
+    assert four < one  # chunking shrinks the exposed ramp
+    # n == 1: nothing to hide — chunking must never look profitable
+    local1 = pm.estimate_ep_moe_ms(n=1, n_chunks=1, overlap=True, **kw)
+    local8 = pm.estimate_ep_moe_ms(n=1, n_chunks=8, overlap=True, **kw)
+    assert local1 <= local8
+    # sequential degenerate: overlap=False with q chunks >= overlap=True
+    assert pm.estimate_ep_moe_ms(n=8, n_chunks=4, overlap=False, **kw) \
+        >= four
+
+
+def test_choose_ep_chunks_divides_capacity_and_degenerates_locally():
+    chip = pm.CHIPS["TPU v5 lite"]
+    cap = 128 * 8
+    q = pm.choose_ep_chunks(128, 7168, 256, 2, 8, 8, capacity=cap,
+                            chip=chip, overlap=True)
+    assert q >= 1 and cap % q == 0
+    # comm-exposed shape at n=8 must pipeline UNDER THE TRUE-OVERLAP
+    # model (the in-kernel-consumer target)
+    assert q > 1
+    assert pm.choose_ep_chunks(128, 7168, 256, 2, 1, 8, capacity=cap,
+                               chip=chip, overlap=True) == 1
+    # the default models the EXECUTED composition (transport completes
+    # before the FFNs start): extra chunks only add per-chunk GEMM and
+    # weight-restream cost, so the pick must degenerate to 1 at ANY n —
+    # a q>1 default here would be a model-driven slowdown
+    for n in (1, 8):
+        assert pm.choose_ep_chunks(128, 7168, 256, 2, n, 8,
+                                   capacity=cap, chip=chip) == 1
+
+
+def test_prune_ep_moe_configs_frontier_and_levels():
+    """The pruner must keep the model-optimal chunk count (within slack)
+    at EVERY capacity level — capacity_factor is a quality trade the
+    time model cannot fold away — and respect top_n within levels."""
+    from triton_dist_tpu.autotuner import (
+        ep_moe_config_space,
+        prune_ep_moe_configs,
+    )
+    from triton_dist_tpu.kernels.ep_a2a import EpMoeConfig
+
+    chip = pm.CHIPS["TPU v5 lite"]
+    kw = dict(m=128, hidden=7168, inter=256, e_loc=2, n=8, top_k=8,
+              chip=chip)
+    pruned = prune_ep_moe_configs(**kw)
+    space = ep_moe_config_space()
+    assert 0 < len(pruned) < len(space)
+    levels = {c.capacity_factor for c in space}
+    assert {c.capacity_factor for c in pruned} == levels
+    # the model's own argmin at each level survives the frontier
+    for cf in levels:
+        best = min(
+            (c for c in space if c.capacity_factor == cf),
+            key=lambda c: pm.estimate_ep_moe_ms(
+                n_chunks=c.n_chunks,
+                capacity=c.fit_capacity(128, 8), **kw),
+        )
+        kept = [c for c in pruned if c.capacity_factor == cf]
+        assert any(c.n_chunks == best.n_chunks for c in kept), (cf, kept)
+    top = prune_ep_moe_configs(top_n=1, **kw)
+    assert len(top) == len(levels)
+    assert prune_ep_moe_configs(configs=[], **kw) == [EpMoeConfig()]
+
+
 # -- bench result schema (ISSUE 1 satellite: CI catches metric drift) --------
 
 
@@ -165,6 +244,29 @@ def test_bench_schema_accepts_wellformed(bench_mod):
     fail = {"metric": "mega_decode_qwen3_8b_ms", "value": -1.0,
             "unit": "ms", "vs_baseline": -1.0, "error": "tunnel glitch"}
     assert bench_mod.check_result(fail) == []
+
+
+def test_bench_schema_accepts_ep_moe_keys(bench_mod):
+    """ISSUE 2 satellite: the chunk-pipelined EP MoE metrics are schema
+    keys, so a rename silently breaking the driver's trend tracking
+    becomes a nonzero bench exit instead."""
+    good = {"metric": "mega_decode_qwen3_8b_ms", "value": 2.8,
+            "unit": "ms", "vs_baseline": 0.86,
+            "ep_moe_fwd_us": 990.0, "ep_moe_seq_us": 1080.0,
+            "ep_moe_xla_us": 910.0, "ep_moe_overlap_vs_seq": 0.92,
+            "ep_moe_chunks": 1, "ep_moe_drop_frac": 0.0}
+    assert bench_mod.check_result(good) == []
+    for key in ("ep_moe_fwd_us", "ep_moe_seq_us", "ep_moe_xla_us",
+                "ep_moe_overlap_vs_seq", "ep_moe_chunks",
+                "ep_moe_drop_frac"):
+        assert key in bench_mod._NUMERIC_KEYS
+        assert any("must be numeric" in p for p in bench_mod.check_result(
+            dict(good, **{key: "fast"})))
+    # the typo'd variant is schema drift, not a new metric
+    assert any("unknown key" in p for p in bench_mod.check_result(
+        dict(good, ep_moe_fwd_uss=1.0)))
+    assert any("malformed value" in p for p in bench_mod.check_result(
+        dict(good, ep_moe_drop_frac=float("nan"))))
 
 
 def test_bench_schema_flags_drift(bench_mod):

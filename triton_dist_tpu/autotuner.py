@@ -351,6 +351,75 @@ def prune_gemm_rs_local_configs(m, k_loc, n_full, configs=None,
         slack, chip, top_n)
 
 
+def ep_moe_config_space():
+    """Candidate EpMoeConfig grid for the chunk-pipelined EP MoE
+    (kernels/ep_a2a.ep_moe_pipeline): chunk counts spanning no-pipelining
+    to fine-grained overlap, at the lossless capacity plus the two
+    standard GShard capacity trades. capacity_factor < 1.0 changes WHAT
+    is computed (tokens beyond capacity drop), not just how fast — see
+    prune_ep_moe_configs for how the pruner keeps the trade visible."""
+    from triton_dist_tpu.kernels.ep_a2a import EpMoeConfig
+
+    return [
+        EpMoeConfig(n_chunks=q, capacity_factor=cf)
+        for q in (1, 2, 4, 8, 16)
+        for cf in (1.0, 0.75, 0.5)
+    ]
+
+
+def prune_ep_moe_configs(m, hidden, inter, e_loc, n, top_k, configs=None,
+                         dtype=None, payload_dtype=None, slack=1.25,
+                         chip=None, top_n=None):
+    """Model-pruned chunk-pipeline candidates at one shape: within EACH
+    capacity_factor level (a quality trade the model cannot score — it
+    predicts time, not accuracy), keep the chunk counts on the
+    perf_model.estimate_ep_moe_ms roofline frontier, dedupe configs that
+    degrade to the same fitted chunk count, and optionally cap each
+    level at the top_n model-ranked. Mirrors prune_ag_gemm_configs'
+    frontier+dedupe+top_n discipline."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.ep_a2a import EpMoeConfig, fit_chunks
+    from triton_dist_tpu.perf_model import (
+        estimate_ep_moe_ms,
+        roofline_frontier,
+    )
+
+    dtype = dtype or jnp.bfloat16
+    configs = list(configs) if configs is not None else ep_moe_config_space()
+
+    def fitted(cfg):
+        # the config's own fitting rules: a tuned config must describe
+        # the capacity and chunking that actually execute
+        cap = cfg.fit_capacity(m, top_k)
+        return cap, fit_chunks(cfg.n_chunks, cap)
+
+    def model_ms(cfg):
+        cap, q = fitted(cfg)
+        return estimate_ep_moe_ms(
+            m, hidden, inter, e_loc, n, top_k, capacity=cap, n_chunks=q,
+            dtype=dtype, payload_dtype=payload_dtype, chip=chip,
+            overlap=True,
+        )
+
+    out = []
+    for cf in sorted({c.capacity_factor for c in configs}, reverse=True):
+        level = [c for c in configs if c.capacity_factor == cf]
+        seen = set()
+        uniq = []
+        for c in roofline_frontier(level, model_ms, slack):
+            ft = fitted(c)
+            if ft not in seen:
+                seen.add(ft)
+                uniq.append(c)
+        if top_n is not None and len(uniq) > top_n:
+            uniq = sorted(uniq, key=model_ms)[:top_n]
+        out.extend(uniq)
+    if not out:
+        out = [EpMoeConfig()]
+    return out
+
+
 def _default_key_part(argname, a):
     """Stable cache-key fragment for one argument of an autotuned call.
 

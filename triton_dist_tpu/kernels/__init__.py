@@ -49,6 +49,7 @@ from triton_dist_tpu.kernels.low_latency_allgather import (  # noqa: F401
 )
 from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
     all_to_all,
+    all_to_all_chunked,
     fast_all_to_all,
     all_to_all_ref,
 )
@@ -59,8 +60,10 @@ from triton_dist_tpu.kernels.p2p import (  # noqa: F401
 )
 from triton_dist_tpu.kernels.moe_utils import (  # noqa: F401
     ExpertSort,
+    chunk_group_sizes,
     combine_topk,
     expert_histogram,
+    silu_mul,
     sort_by_expert,
     topk_routing,
 )
@@ -74,10 +77,16 @@ from triton_dist_tpu.kernels.allgather_group_gemm import (  # noqa: F401
     moe_reduce_rs,
 )
 from triton_dist_tpu.kernels.ep_a2a import (  # noqa: F401
+    EPChunkDispatch,
     EPDispatch,
+    EpMoeConfig,
     ep_combine,
+    ep_combine_chunked,
     ep_dispatch,
+    ep_dispatch_chunked,
     ep_expert_ffn,
+    ep_expert_ffn_chunked,
+    ep_moe_pipeline,
 )
 from triton_dist_tpu.kernels.sp_attention import (  # noqa: F401
     ring_attention,

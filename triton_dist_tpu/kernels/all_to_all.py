@@ -22,7 +22,7 @@ by construction and no parity state exists.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -89,9 +89,11 @@ def all_to_all(
     low_latency_all_to_all.py:198 `fast_all_to_all`).
 
     x: (n, m, hidden) send buffer — segment i goes to rank i.
-    splits: (n,) int32 — actual token counts per segment.
-    Returns (out, out_splits): out[j] holds rank j's segment for us, valid
-    rows given by out_splits[j].
+    splits: (n,) or (n, S) int32 — per-segment metadata rows travelling
+    alongside (the classic case is the single valid-token count; the
+    chunk-pipelined EP dispatch rides its per-expert counts here too).
+    Returns (out, out_splits): out[j] holds rank j's segment for us, with
+    rank j's metadata row in out_splits[j] (same shape as splits).
     """
     n = jax.lax.axis_size(axis)
     if x.shape[0] != n:
@@ -100,12 +102,12 @@ def all_to_all(
         return x, splits.astype(jnp.int32)
     if interpret_no_headroom():
         return all_to_all_ref(x, splits, axis)
-    splits2d = splits.reshape(n, 1).astype(jnp.int32)
+    splits2d = splits.reshape(n, -1).astype(jnp.int32)
     out, out_splits = tpu_call(
         functools.partial(_a2a_kernel, axis, n),
         out_shape=(
             jax.ShapeDtypeStruct(x.shape, x.dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct(splits2d.shape, jnp.int32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -127,7 +129,7 @@ def all_to_all(
             collective_id=next_collective_id(f"a2a_{axis}"),
         ),
     )(x, splits2d)
-    return out, out_splits.reshape(n)
+    return out, out_splits.reshape(splits.shape)
 
 
 def fast_all_to_all(x, splits, axis: str = EP_AXIS):
@@ -137,9 +139,153 @@ def fast_all_to_all(x, splits, axis: str = EP_AXIS):
 
 
 def all_to_all_ref(x: jax.Array, splits: jax.Array, axis: str = EP_AXIS):
-    """XLA reference path (lax.all_to_all over the leading dim)."""
+    """XLA reference path (lax.all_to_all over the leading dim).
+    splits may be (n,) or (n, S); the output matches its shape."""
     out = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+    n = x.shape[0]
     out_splits = jax.lax.all_to_all(
-        splits.reshape(-1, 1), axis, split_axis=0, concat_axis=0, tiled=True
-    ).reshape(-1)
+        splits.reshape(n, -1), axis, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(splits.shape)
     return out, out_splits
+
+
+# -- chunked transport (the EP MoE pipeline's arrival-granular A2A) ----------
+
+
+def _a2a_chunked_kernel(axis, n, q, rows, straggler, x_ref, s_ref, o_ref,
+                        os_ref, cp_sem, send_sem, recv_sems, meta_send_sem,
+                        meta_recv_sem):
+    """Chunk-granular A2A: segment payloads travel as `q` row-chunks, and
+    chunk (step i, c) lands on its OWN delivery semaphore slot
+    recv_sems[i, c] — the TPU analog of the reference's per-peer
+    putmem_signal + signal_wait_until (low_latency_all_to_all.py:36-118):
+    a consumer can wait on chunk c of every source while chunks c+1..q-1
+    are still in flight.
+
+    Semaphore slots are indexed by RING STEP i (source offset me-i), not
+    absolute source rank: every rank's descriptor for step (i, c) then
+    names the same static slot, which is what both the hardware DMA
+    (slot on the destination chip) and the legacy interpreter's lockstep
+    discharge (slot on the local instance) require to agree."""
+    me = jax.lax.axis_index(axis)
+    shmem.barrier_all(axis)
+    if straggler is not None:
+        # race provocation: stall one rank between entering the kernel
+        # and issuing its sends, so its peers' per-chunk waits really
+        # wait (pattern of the megakernel AR skew stress)
+        shmem.straggler_delay(axis, straggler[0], straggler[1])
+
+    # Local segment: chunk-granular local copies, each on its own slot
+    # (recv_sems row 0 — ring step 0 is "self", so the slot space is
+    # uniform: slot [i, c] == chunk c from source offset i). A shared
+    # local semaphore would let chunk c's wait be satisfied by chunk
+    # c+1's completion (waits are byte-counted, not tagged), silently
+    # voiding the chunk-major arrival guarantee.
+    local = []
+    for c in range(q):
+        sl = pl.ds(c * rows, rows)
+        cp = pltpu.make_async_copy(x_ref.at[me, sl], o_ref.at[me, sl],
+                                   recv_sems.at[0, c])
+        cp.start()
+        local.append(cp)
+    cps = pltpu.make_async_copy(s_ref.at[me], os_ref.at[me], cp_sem)
+
+    handles = {}
+    meta_handles = []
+    for i in range(1, n):
+        peer = jnp.mod(me + i, n)
+        for c in range(q):
+            sl = pl.ds(c * rows, rows)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[peer, sl],
+                dst_ref=o_ref.at[me, sl],
+                send_sem=send_sem,
+                recv_sem=recv_sems.at[i, c],
+                device_id={axis: peer},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdma.start()
+            handles[(i, c)] = rdma
+        meta = pltpu.make_async_remote_copy(
+            src_ref=s_ref.at[peer],
+            dst_ref=os_ref.at[me],
+            send_sem=meta_send_sem,
+            recv_sem=meta_recv_sem,
+            device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        meta.start()
+        meta_handles.append(meta)
+
+    # Chunk-major consumption: after iteration c the output rows of chunk
+    # c are complete FROM EVERY SOURCE while chunks c+1.. are still in
+    # flight — the wait order a fused consumer interleaves compute into.
+    for c in range(q):
+        local[c].wait()
+        for i in range(1, n):
+            handles[(i, c)].wait()
+    cps.start()
+    cps.wait()
+    for h in meta_handles:
+        h.wait()
+
+
+def all_to_all_chunked(
+    x: jax.Array,
+    splits: jax.Array,
+    axis: str = EP_AXIS,
+    n_chunks: int = 1,
+    straggler: Optional[Tuple[int, int]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """all_to_all with per-chunk delivery semaphores: each segment's rows
+    travel as `n_chunks` independently-signalled chunks (see
+    _a2a_chunked_kernel). Byte-identical output to `all_to_all`; what
+    changes is the ARRIVAL protocol — chunk c of every source can be
+    consumed while later chunks stream, which is what the chunk-pipelined
+    EP MoE dispatch builds on (kernels/ep_a2a.py).
+
+    x: (n, C, hidden) with C % n_chunks == 0; splits: (n,) or (n, S).
+    straggler: optional (rank, nanos) skew injection for stress tests.
+    """
+    n = jax.lax.axis_size(axis)
+    if x.shape[0] != n:
+        raise ValueError(f"x leading dim {x.shape[0]} != axis size {n}")
+    q = int(n_chunks)
+    if q < 1 or x.shape[1] % q:
+        raise ValueError(
+            f"n_chunks={q} must be >= 1 and divide the capacity dim "
+            f"{x.shape[1]}"
+        )
+    if n == 1:
+        return x, splits.astype(jnp.int32)
+    if interpret_no_headroom():
+        return all_to_all_ref(x, splits, axis)
+    rows = x.shape[1] // q
+    splits2d = splits.reshape(n, -1).astype(jnp.int32)
+    out, out_splits = tpu_call(
+        functools.partial(_a2a_chunked_kernel, axis, n, q, rows, straggler),
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct(splits2d.shape, jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((n, q)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(f"a2a_chunk{q}_{axis}"),
+        ),
+    )(x, splits2d)
+    return out, out_splits.reshape(splits.shape)

@@ -14,17 +14,33 @@ Pallas all_to_all (all segments + counts in flight concurrently).
 
 Metadata rides as a second A2A payload: (src_row, local_expert, weight,
 valid) per slot, the splits-metadata analog.
+
+The CHUNK-PIPELINED path (ep_dispatch_chunked / ep_expert_ffn_chunked /
+ep_combine_chunked, assembled by ep_moe_pipeline) is the TPU analog of
+the reference's double-buffered dispatch/combine overlap
+(layers/nvidia/ep_a2a_layer.py:118-138): segments are expert-sorted at
+pack time and their per-expert counts travel with the splits metadata,
+so the receive side needs NO runtime sort — each capacity chunk's
+grouped-GEMM segment structure is derived arithmetically
+(moe_utils.chunk_group_sizes) and the FFN can run chunk-by-chunk as
+chunks arrive (all_to_all_chunked's per-chunk delivery semaphores).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.kernels.all_to_all import all_to_all, all_to_all_ref
+from triton_dist_tpu.kernels.all_to_all import (
+    all_to_all,
+    all_to_all_chunked,
+    all_to_all_ref,
+)
 from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
+from triton_dist_tpu.kernels.moe_utils import chunk_group_sizes, silu_mul
 from triton_dist_tpu.lang.core import interpret_no_headroom
 from triton_dist_tpu.runtime.init import EP_AXIS
 
@@ -45,6 +61,7 @@ class EPDispatch(NamedTuple):
     send_weight: jax.Array  # (n, C) topk weight per sent slot
     send_valid: jax.Array  # (n, C) bool, send side
     send_counts: jax.Array  # (n,) slots we sent per destination
+    drops: jax.Array  # () int32 — (token, choice) pairs beyond capacity
 
 
 _FP8_MAX = 448.0  # e4m3 finite max
@@ -75,16 +92,36 @@ def _quantize_fp8(x):
     return q, s
 
 
+class _Pack(NamedTuple):
+    """Origin-side pack of routed tokens into per-destination buffers."""
+
+    send_x: jax.Array      # (n, C, H_pad) wire payload
+    src_rows: jax.Array    # (n, C) our token row per slot
+    weights: jax.Array     # (n, C) topk weight per slot
+    valid: jax.Array       # (n, C) bool
+    counts: jax.Array      # (n,) valid slots per destination
+    drops: jax.Array       # () int32 pairs beyond capacity
+    exp_counts: Optional[jax.Array]  # (n, E_loc) when expert_sorted
+
+
 def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
-                  payload_dtype=None):
+                  payload_dtype=None, expert_sorted=False) -> _Pack:
     """Build fixed-capacity per-destination send buffers.
 
-    x: (M, H); ids/weights: (M, k). Returns (send_x (n, C, H_pad) with the
-    local-expert id folded into column H of the lane padding — one a2a
-    moves tokens AND routing; send_row/send_w/valid (n, C) origin-side
-    combine metadata; counts (n,)). Slot allocation is a stable sort by
-    destination rank — the static analog of the reference's atomic slot
-    counter (ep_a2a.py:133-150).
+    x: (M, H); ids/weights: (M, k). Returns a _Pack: send_x (n, C, H_pad)
+    with the local-expert id folded into column H of the lane padding —
+    one a2a moves tokens AND routing; src_rows/weights/valid (n, C)
+    origin-side combine metadata; counts (n,); drops, the overflow stat.
+    Slot allocation is a stable sort by destination rank — the static
+    analog of the reference's atomic slot counter (ep_a2a.py:133-150).
+
+    expert_sorted=True re-orders each destination block's KEPT slots by
+    local expert (invalid slots packed at the tail) for the chunked
+    pipeline: the receive side then needs no runtime sort, only the
+    per-(destination, expert) counts returned in exp_counts. Which pairs
+    survive the capacity cut is decided BEFORE this permutation, so
+    routing and drops are identical to the unsorted pack — the
+    overlapped and sequential MoE paths drop the same tokens.
 
     payload_dtype=float8_e4m3fn selects the fp8 wire format (half the ICI
     bytes of bf16 — the reference's 137 us dispatch class): tokens are
@@ -117,6 +154,26 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
     w_flat = jnp.where(
         valid, weights.reshape(-1)[entry].astype(jnp.float32), 0.0
     )
+
+    exp_counts = None
+    if expert_sorted:
+        # Second stable sort, WITHIN destination blocks only (slot_dest
+        # is the major key, and slots are already dest-major, so the
+        # global stable sort never crosses a block boundary): valid
+        # slots grouped by local expert, invalid slots to the tail.
+        key = slot_dest * (experts_per_rank + 1) + jnp.where(
+            valid, local_exp, experts_per_rank
+        )
+        perm = jnp.argsort(key, stable=True)
+        src_rows = src_rows[perm]
+        local_exp = local_exp[perm]
+        w_flat = w_flat[perm]
+        valid = valid[perm]
+        exp_counts = jnp.bincount(
+            jnp.where(valid, slot_dest * experts_per_rank + local_exp,
+                      n_ranks * experts_per_rank),
+            length=n_ranks * experts_per_rank + 1,
+        )[:-1].reshape(n_ranks, experts_per_rank).astype(jnp.int32)
 
     h = x.shape[1]
     if _byte_wire(payload_dtype):
@@ -152,12 +209,15 @@ def _pack_by_dest(x, ids, weights, n_ranks, experts_per_rank, capacity,
             jnp.zeros((n_ranks * c, h_pad - h - 1), x.dtype),
         ], axis=-1)
     counts = jnp.minimum(seg_count, capacity).astype(jnp.int32)
-    return (
-        send_x.reshape(n_ranks, c, h_pad),
-        src_rows.reshape(n_ranks, c),
-        w_flat.reshape(n_ranks, c),
-        valid.reshape(n_ranks, c),
-        counts,
+    drops = jnp.sum(jnp.maximum(seg_count - capacity, 0)).astype(jnp.int32)
+    return _Pack(
+        send_x=send_x.reshape(n_ranks, c, h_pad),
+        src_rows=src_rows.reshape(n_ranks, c),
+        weights=w_flat.reshape(n_ranks, c),
+        valid=valid.reshape(n_ranks, c),
+        counts=counts,
+        drops=drops,
+        exp_counts=exp_counts,
     )
 
 
@@ -180,14 +240,32 @@ def ep_dispatch(
     n = jax.lax.axis_size(axis)
     h = x.shape[1]
     experts_per_rank = n_experts // n
-    send_x, send_row, send_w, send_valid, counts = _pack_by_dest(
+    pack = _pack_by_dest(
         x, topk_ids, topk_weights, n, experts_per_rank, capacity,
         payload_dtype,
     )
     a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
-    recv, recv_counts = a2a(send_x, counts, axis)
+    recv, recv_counts = a2a(pack.send_x, pack.counts, axis)
     slot_idx = jnp.arange(capacity)[None, :]
     recv_valid = slot_idx < recv_counts[:, None]
+    tokens, local_expert = _decode_payload(recv, h, n, capacity,
+                                           payload_dtype, x.dtype)
+    return EPDispatch(
+        x=tokens,
+        local_expert=local_expert,
+        valid=recv_valid,
+        counts=recv_counts,
+        send_src_row=pack.src_rows,
+        send_weight=pack.weights,
+        send_valid=pack.valid,
+        send_counts=pack.counts,
+        drops=pack.drops,
+    )
+
+
+def _decode_payload(recv, h, n, capacity, payload_dtype, out_dtype):
+    """Unfold the wire payload: (tokens (n, C, H) in out_dtype,
+    local_expert (n, C) int32) — fp8 dequant or lane-padding extraction."""
     if _byte_wire(payload_dtype):
         meta = jax.lax.bitcast_convert_type(
             recv[..., h:h + 8], jnp.uint8
@@ -199,20 +277,11 @@ def ep_dispatch(
             meta[..., 4:], jnp.int32
         ).reshape(n, capacity)
         tokens = (recv[..., :h].astype(jnp.float32)
-                  * scale[..., None]).astype(x.dtype)
+                  * scale[..., None]).astype(out_dtype)
     else:
         tokens = recv[..., :h]
         local_expert = recv[..., h].astype(jnp.int32)
-    return EPDispatch(
-        x=tokens,
-        local_expert=local_expert,
-        valid=recv_valid,
-        counts=recv_counts,
-        send_src_row=send_row,
-        send_weight=send_w,
-        send_valid=send_valid,
-        send_counts=counts,
-    )
+    return tokens, local_expert
 
 
 def ep_expert_ffn(
@@ -239,11 +308,25 @@ def ep_expert_ffn(
     w_gu = jnp.concatenate([w_gate_up, w_gate_up[:1]], axis=0)
     w_dn = jnp.concatenate([w_down, w_down[:1]], axis=0)
     hh = grouped_gemm(x_sorted, w_gu, group_sizes, out_dtype=jnp.float32)
-    gate, up = jnp.split(hh, 2, axis=-1)
-    act = (jax.nn.silu(gate) * up).astype(disp.x.dtype)
+    act = silu_mul(hh).astype(disp.x.dtype)
     y_sorted = grouped_gemm(act, w_dn, group_sizes, out_dtype=jnp.float32)
     y = y_sorted[inv].reshape(n, c, h)
     return jnp.where(disp.valid[..., None], y, 0.0)
+
+
+def _combine_scatter(back, disp, m, out_dtype):
+    """Weighted scatter of returned segments into (M, H): back[j] is the
+    segment this rank originally packed for rank j, so the origin-side
+    send_* metadata pairs with it directly — no metadata travels back.
+    Shared by the sequential and chunk-pipelined combines (their outputs
+    must be bitwise comparable)."""
+    n, c, h = back.shape
+    rows = disp.send_src_row.reshape(-1)
+    w = jnp.where(disp.send_valid, disp.send_weight, 0.0).reshape(-1)
+    contrib = back.reshape(-1, h) * w[:, None]
+    out = jnp.zeros((m, h), jnp.float32)
+    out = out.at[rows].add(contrib, mode="drop")
+    return out.astype(out_dtype)
 
 
 def ep_combine(
@@ -254,17 +337,246 @@ def ep_combine(
     axis: str = EP_AXIS,
 ) -> jax.Array:
     """Send results back to their source ranks and weighted-scatter into
-    (M, H) (ref combine path, ep_a2a.py:152 + ep_a2a_layer.py:240).
-
-    back[j] is the segment this rank originally packed for rank j, so the
-    origin-side send_* metadata pairs with it directly — no metadata
-    travels back."""
+    (M, H) (ref combine path, ep_a2a.py:152 + ep_a2a_layer.py:240)."""
     a2a = all_to_all_ref if interpret_no_headroom() else all_to_all
     back, _ = a2a(y.astype(jnp.float32), disp.counts, axis)
-    n, c, h = back.shape
-    rows = disp.send_src_row.reshape(-1)
-    w = jnp.where(disp.send_valid, disp.send_weight, 0.0).reshape(-1)
-    contrib = back.reshape(-1, h) * w[:, None]
-    out = jnp.zeros((m, h), jnp.float32)
-    out = out.at[rows].add(contrib, mode="drop")
-    return out.astype(out_dtype)
+    return _combine_scatter(back, disp, m, out_dtype)
+
+
+# -- chunk-pipelined EP MoE ---------------------------------------------------
+#
+# The overlap story (ref: the double-buffered dispatch/combine of
+# ep_a2a_layer.py:118-138 and T3-style fine-grained chunk overlap):
+#
+#   1. the pack expert-sorts each destination segment and ships the
+#      per-(destination, expert) counts in the splits metadata, so the
+#      receive side derives every chunk's grouped-GEMM segment structure
+#      arithmetically (moe_utils.chunk_group_sizes) — no runtime argsort,
+#      no inverse gather: the FFN consumes and produces slot order;
+#   2. the transport is all_to_all_chunked: every capacity chunk gets its
+#      own delivery semaphore, so chunk c is consumable while chunks
+#      c+1.. are still in flight (the in-kernel wait order is chunk-major);
+#   3. the expert FFN runs per chunk (gate_up -> silu -> down over the
+#      chunk's expert segments), and the combine streams the per-chunk
+#      results back through the same chunked transport.
+#
+# Even at n == 1 (no comm) the pipeline is the cheaper formulation: the
+# sequential ep_expert_ffn pays a runtime argsort plus two full-width
+# token gathers (sort + unsort) per layer; the pipelined FFN pays one
+# extra int argsort at pack time and no (T, H) gathers at all.
+
+
+@dataclasses.dataclass(frozen=True)
+class EpMoeConfig:
+    """Tunable knobs of the chunk-pipelined EP MoE (autotuner.
+    ep_moe_config_space): n_chunks trades exposed A2A time against
+    per-chunk grouped-GEMM efficiency (perf_model.estimate_ep_moe_ms);
+    capacity_factor < 1 opts into the GShard drop trade (1.0 is
+    lossless)."""
+
+    n_chunks: int = 1
+    capacity_factor: float = 1.0
+
+    def fit_capacity(self, m: int, top_k: int) -> int:
+        """THE capacity-from-factor rule — defined once, beside the
+        config it belongs to, so the pruner's model and any runtime
+        consumer derive the same capacity (a divergent re-derivation,
+        e.g. int() truncation, would tune a chunking that never
+        executes)."""
+        import math
+
+        return max(1, math.ceil(m * top_k * self.capacity_factor))
+
+
+class EPChunkDispatch(NamedTuple):
+    """ep_dispatch_chunked result: like EPDispatch, but segments arrive
+    expert-sorted (invalid slots at each segment's tail) and carry their
+    per-expert counts instead of per-slot expert ids."""
+
+    x: jax.Array  # (n, C, H) tokens, expert-sorted within each segment
+    expert_counts: jax.Array  # (n, E_loc) valid rows per (segment, expert)
+    valid: jax.Array  # (n, C) bool (tail slots invalid)
+    counts: jax.Array  # (n,) valid slots per received segment
+    send_src_row: jax.Array  # (n, C)
+    send_weight: jax.Array  # (n, C)
+    send_valid: jax.Array  # (n, C)
+    send_counts: jax.Array  # (n,)
+    drops: jax.Array  # () int32
+
+
+def _a2a_select(transport, n_chunks, straggler):
+    """Transport arm of the pipeline: 'chunked' (per-chunk delivery
+    semaphores), 'plain' (the single-shot kernel), or 'ref' (the XLA
+    collective — the bit-identity oracle: all three move identical
+    bytes, which the overlap parity tests assert)."""
+    if transport == "chunked":
+        return lambda x, s, axis: all_to_all_chunked(
+            x, s, axis, n_chunks=n_chunks, straggler=straggler)
+    if transport == "plain":
+        return all_to_all  # falls back to the ref itself under
+        # interpret_no_headroom — no second copy of that predicate here
+    if transport == "ref":
+        return all_to_all_ref
+    raise ValueError(f"unknown transport {transport!r}")
+
+
+def ep_dispatch_chunked(
+    x: jax.Array,  # (M, H) this rank's tokens
+    topk_ids: jax.Array,  # (M, k) global expert ids
+    topk_weights: jax.Array,  # (M, k)
+    n_experts: int,
+    capacity: int,
+    axis: str = EP_AXIS,
+    n_chunks: int = 1,
+    payload_dtype=None,
+    transport: str = "chunked",
+    straggler: Optional[Tuple[int, int]] = None,
+) -> EPChunkDispatch:
+    """Chunk-pipelined dispatch: expert-sorted pack + chunked A2A. Same
+    routing and same drops as ep_dispatch (the capacity cut happens
+    before the expert sort); the travelling metadata row is
+    [count, per-expert counts] per destination."""
+    n = jax.lax.axis_size(axis)
+    h = x.shape[1]
+    experts_per_rank = n_experts // n
+    pack = _pack_by_dest(
+        x, topk_ids, topk_weights, n, experts_per_rank, capacity,
+        payload_dtype, expert_sorted=True,
+    )
+    meta = jnp.concatenate([pack.counts[:, None], pack.exp_counts], axis=1)
+    a2a = _a2a_select(transport, n_chunks, straggler)
+    recv, recv_meta = a2a(pack.send_x, meta, axis)
+    recv_counts = recv_meta[:, 0]
+    recv_exp_counts = recv_meta[:, 1:]
+    slot_idx = jnp.arange(capacity)[None, :]
+    recv_valid = slot_idx < recv_counts[:, None]
+    tokens, _ = _decode_payload(recv, h, n, capacity, payload_dtype,
+                                x.dtype)
+    return EPChunkDispatch(
+        x=tokens,
+        expert_counts=recv_exp_counts,
+        valid=recv_valid,
+        counts=recv_counts,
+        send_src_row=pack.src_rows,
+        send_weight=pack.weights,
+        send_valid=pack.valid,
+        send_counts=pack.counts,
+        drops=pack.drops,
+    )
+
+
+def fit_chunks(n_chunks: int, capacity: int) -> int:
+    """Largest chunk count <= n_chunks dividing capacity — THE fitting
+    rule for every consumer (layer, autotuner pruner): a chunk count
+    must never change `capacity` itself (capacity fixes which tokens
+    drop; the overlapped and sequential paths must drop the same ones),
+    so the count adapts, not the capacity. Shared so a tuned
+    EpMoeConfig always describes the chunking that actually executes."""
+    q = max(1, min(int(n_chunks), capacity))
+    while capacity % q:
+        q -= 1
+    return q
+
+
+def _extended_stacks(w_gate_up, w_down):
+    """Null-group-extended expert stacks for the per-chunk grouped
+    GEMMs: one extra trailing block (expert 0's weights) that the
+    invalid-slot tail group runs against — its rows are masked out by
+    the caller. NOT tiled per segment: the per-chunk FFN loops the
+    (E_loc+1)-block stack over segments, so no n-fold HBM copy of the
+    weights ever materializes."""
+    w_gu = jnp.concatenate([w_gate_up, w_gate_up[:1]], axis=0)
+    w_dn = jnp.concatenate([w_down, w_down[:1]], axis=0)
+    return w_gu, w_dn
+
+
+def ep_expert_ffn_chunked(
+    disp: EPChunkDispatch,
+    w_gate_up: jax.Array,  # (E_loc, H, 2I)
+    w_down: jax.Array,  # (E_loc, I, H)
+    n_chunks: int = 1,
+) -> jax.Array:
+    """Run this rank's experts chunk-by-chunk over the received tokens ->
+    (n, C, H) f32 in slot order.
+
+    Segments arrived expert-sorted, so chunk c's grouped-GEMM structure
+    is pure arithmetic over the travelled per-expert counts — no argsort,
+    no gather, and the output is already in slot order for the combine.
+    Each chunk's FFN depends only on that chunk's rows: the compute for
+    chunk c is issueable the moment all_to_all_chunked's chunk-c
+    semaphores clear, while chunks c+1.. are still on the wire."""
+    n, c, h = disp.x.shape
+    if c % n_chunks:
+        raise ValueError(f"n_chunks={n_chunks} must divide capacity {c}")
+    w_gu_e, w_dn_e = _extended_stacks(w_gate_up, w_down)
+    rows = c // n_chunks
+    ys = []
+    for ci in range(n_chunks):
+        lo = ci * rows
+        gs = chunk_group_sizes(disp.expert_counts, c, lo, rows)
+        xc = jax.lax.slice_in_dim(disp.x, lo, lo + rows, axis=1)
+        # chunk rows are segment-major and the group-id sequence restarts
+        # at every segment boundary, so the FFN loops segments (static,
+        # n <= mesh axis) against the ONE (E_loc+1)-block stack rather
+        # than tiling the weights n-fold in HBM
+        yseg = []
+        for j in range(n):
+            hh = grouped_gemm(xc[j], w_gu_e, gs[j], out_dtype=jnp.float32)
+            act = silu_mul(hh).astype(disp.x.dtype)
+            yseg.append(
+                grouped_gemm(act, w_dn_e, gs[j], out_dtype=jnp.float32))
+        ys.append(jnp.stack(yseg, axis=0))  # (n, rows, h)
+    y = jnp.concatenate(ys, axis=1) if len(ys) > 1 else ys[0]
+    # null-group rows ran expert 0's weights; mask them out
+    return jnp.where(disp.valid[..., None], y, 0.0)
+
+
+def ep_combine_chunked(
+    y: jax.Array,  # (n, C, H) expert outputs per source rank, slot order
+    disp: EPChunkDispatch,
+    m: int,
+    out_dtype,
+    axis: str = EP_AXIS,
+    n_chunks: int = 1,
+    transport: str = "chunked",
+    straggler: Optional[Tuple[int, int]] = None,
+) -> jax.Array:
+    """Chunk-streamed combine: each capacity chunk of the result buffer
+    travels back on its own delivery semaphore as it finishes, instead
+    of waiting for the full (n, C, H) buffer (the return leg of the
+    reference's double-buffered combine, ep_a2a_layer.py:240)."""
+    a2a = _a2a_select(transport, n_chunks, straggler)
+    back, _ = a2a(y.astype(jnp.float32), disp.counts, axis)
+    return _combine_scatter(back, disp, m, out_dtype)
+
+
+def ep_moe_pipeline(
+    x: jax.Array,  # (M, H) this rank's tokens
+    topk_ids: jax.Array,  # (M, k)
+    topk_weights: jax.Array,  # (M, k)
+    w_gate_up: jax.Array,  # (E_loc, H, 2I)
+    w_down: jax.Array,  # (E_loc, I, H)
+    capacity: int,
+    axis: str = EP_AXIS,
+    n_chunks: int = 1,
+    payload_dtype=None,
+    transport: str = "chunked",
+    straggler: Optional[Tuple[int, int]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """The chunk-pipelined EP MoE core: chunked dispatch -> per-chunk
+    grouped FFN -> chunk-streamed combine. Returns ((M, H) f32 output,
+    drops). Same routing and drops as the sequential
+    ep_dispatch/ep_expert_ffn/ep_combine composition."""
+    n = jax.lax.axis_size(axis)
+    n_experts = w_gate_up.shape[0] * n
+    disp = ep_dispatch_chunked(
+        x, topk_ids, topk_weights, n_experts, capacity, axis,
+        n_chunks=n_chunks, payload_dtype=payload_dtype,
+        transport=transport, straggler=straggler,
+    )
+    y = ep_expert_ffn_chunked(disp, w_gate_up, w_down, n_chunks=n_chunks)
+    out = ep_combine_chunked(
+        y, disp, x.shape[0], jnp.float32, axis, n_chunks=n_chunks,
+        transport=transport, straggler=straggler,
+    )
+    return out, disp.drops

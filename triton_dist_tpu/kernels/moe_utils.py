@@ -33,6 +33,15 @@ def topk_routing(
     return weights, ids.astype(jnp.int32)
 
 
+def silu_mul(h: jax.Array) -> jax.Array:
+    """silu(gate) * up over a fused (…, 2I) gate_up projection, in f32 —
+    the FFN epilogue shared by the TP-MoE layer and the EP expert FFNs
+    (sequential and chunk-pipelined paths must share ONE implementation:
+    the overlap parity tests compare their outputs bitwise)."""
+    gate, up = jnp.split(h.astype(jnp.float32), 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
 def expert_histogram(topk_ids: jax.Array, n_experts: int) -> jax.Array:
     """Tokens per expert (the reference's triton bincount,
     ref: kernels/nvidia/ep_a2a.py:310-336)."""
@@ -116,6 +125,36 @@ def pack_by_expert(
         counts=jnp.minimum(seg_count, c).astype(jnp.int32),
         drops=drops,
     )
+
+
+def chunk_group_sizes(
+    expert_counts: jax.Array,  # (n, E) valid rows per (segment, expert)
+    capacity: int,
+    lo: int,
+    rows: int,
+) -> jax.Array:
+    """Expert-group sizes of one capacity chunk of an expert-sorted
+    dispatch buffer — the per-chunk sort/segment metadata of the
+    chunk-pipelined EP MoE (kernels/ep_a2a.py).
+
+    Each received segment is expert-sorted with its invalid slots packed
+    at the tail (ep_a2a._pack_by_dest expert_sorted=True), so segment
+    j's group boundaries are the running sums of expert_counts[j]
+    followed by `capacity` for the trailing null group. The chunk
+    [lo, lo+rows) intersects each group as
+    clip(b[e+1]) - clip(b[e]); returns (n, E+1) int32 summing to `rows`
+    per segment (last column = null/invalid rows — callers mask them)."""
+    n, e = expert_counts.shape
+    bounds = jnp.concatenate(
+        [
+            jnp.zeros((n, 1), jnp.int32),
+            jnp.cumsum(expert_counts.astype(jnp.int32), axis=1),
+            jnp.full((n, 1), capacity, jnp.int32),
+        ],
+        axis=1,
+    )  # (n, E+2): [0, cs_1..cs_E, capacity]
+    clipped = jnp.clip(bounds, lo, lo + rows)
+    return (clipped[:, 1:] - clipped[:, :-1]).astype(jnp.int32)
 
 
 def combine_topk(

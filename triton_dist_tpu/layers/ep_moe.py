@@ -20,6 +20,8 @@ from triton_dist_tpu.kernels.ep_a2a import (
     ep_combine,
     ep_dispatch,
     ep_expert_ffn,
+    ep_moe_pipeline,
+    fit_chunks,
 )
 from triton_dist_tpu.kernels.moe_utils import topk_routing
 from triton_dist_tpu.runtime.init import EP_AXIS
@@ -41,10 +43,27 @@ def ep_moe_fwd(
     capacity: Optional[int] = None,
     axis: str = EP_AXIS,
     payload_dtype=None,
+    overlap: bool = False,
+    n_chunks: Optional[int] = None,
+    return_drops: bool = False,
+    _transport: str = "chunked",
 ):
     """EP MoE forward: route -> dispatch -> local grouped FFN -> combine.
-    Returns (M, H) (ref: ep_a2a_layer.py dispatch/combine +
-    test/nvidia/test_ep_moe_inference.py)."""
+    Returns (M, H), or ((M, H), drops) with return_drops=True — drops is
+    the () int32 count of (token, choice) pairs beyond `capacity`
+    (dropped pairs lose their expert contribution; the token keeps its
+    residual path). (ref: ep_a2a_layer.py dispatch/combine +
+    test/nvidia/test_ep_moe_inference.py.)
+
+    overlap=True takes the chunk-pipelined path (kernels/ep_a2a.
+    ep_moe_pipeline): expert-sorted dispatch over the per-chunk-signalled
+    A2A, per-chunk grouped FFN, chunk-streamed combine. Same routing and
+    same drops as the sequential path by construction. n_chunks=None
+    picks the chunk count from the analytic pipeline model
+    (perf_model.choose_ep_chunks); the count is fitted down to a divisor
+    of `capacity`. `_transport` selects the pipeline's transport arm
+    ('chunked' | 'plain' | 'ref') — test hook for the bit-identity
+    oracle, not a user knob."""
     n = jax.lax.axis_size(axis)
     e_loc = params.w_gate_up.shape[0]
     n_experts = e_loc * n
@@ -55,10 +74,28 @@ def ep_moe_fwd(
         x.astype(jnp.float32), params.w_router.astype(jnp.float32)
     )
     weights, ids = topk_routing(logits, top_k)
+    if overlap:
+        if n_chunks is None:
+            from triton_dist_tpu.perf_model import choose_ep_chunks
+
+            inter = params.w_down.shape[1]
+            n_chunks = choose_ep_chunks(
+                m, x.shape[1], inter, e_loc, n, top_k, capacity=capacity,
+                dtype=x.dtype, payload_dtype=payload_dtype,
+            )
+        q = fit_chunks(n_chunks, capacity)
+        out, drops = ep_moe_pipeline(
+            x, ids, weights, params.w_gate_up, params.w_down, capacity,
+            axis, n_chunks=q, payload_dtype=payload_dtype,
+            transport=_transport,
+        )
+        out = out.astype(x.dtype)
+        return (out, drops) if return_drops else out
     disp = ep_dispatch(x, ids, weights, n_experts, capacity, axis,
                        payload_dtype=payload_dtype)
     y = ep_expert_ffn(disp, params.w_gate_up, params.w_down)
-    return ep_combine(y, disp, m, x.dtype, axis)
+    out = ep_combine(y, disp, m, x.dtype, axis)
+    return (out, disp.drops) if return_drops else out
 
 
 def ep_moe_ref(x, params: EPMoEParams, top_k: int, axis: str = EP_AXIS):

@@ -26,6 +26,7 @@ from triton_dist_tpu.kernels.allgather_group_gemm import (
 from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
 from triton_dist_tpu.kernels.moe_utils import (
     combine_topk,
+    silu_mul as _silu_mul,  # shared FFN epilogue (moe_utils.silu_mul)
     sort_by_expert,
     topk_routing,
 )
@@ -39,11 +40,6 @@ class TPMoEParams(NamedTuple):
     w_router: jax.Array
     w_gate_up: jax.Array
     w_down: jax.Array
-
-
-def _silu_mul(h):
-    gate, up = jnp.split(h.astype(jnp.float32), 2, axis=-1)
-    return jax.nn.silu(gate) * up
 
 
 def tp_moe_fwd(

@@ -261,6 +261,107 @@ def estimate_a2a_ms(
     return volume / (bw * n / 2) * 1e3 + chip.ici_latency_us * 1e-3
 
 
+# -- chunk-pipelined EP MoE model (ISSUE 2 tentpole (c)) ---------------------
+
+
+def estimate_ep_moe_ms(
+    m: int,
+    hidden: int,
+    inter: int,
+    e_loc: int,
+    n: int,
+    top_k: int,
+    capacity: Optional[int] = None,
+    n_chunks: int = 1,
+    dtype=jnp.bfloat16,
+    payload_dtype=None,
+    chip: Optional[ChipSpec] = None,
+    overlap: bool = True,
+) -> float:
+    """Pipeline roofline of the chunk-pipelined EP MoE layer
+    (kernels/ep_a2a.ep_moe_pipeline): per-chunk dispatch A2A vs per-chunk
+    grouped FFN, exposed time = ramp (first chunk's wire time in, last
+    chunk's combine out) + per-chunk max-imbalance.
+
+    The two chunk-count forces the model must capture:
+      - more chunks -> less exposed comm (only the first chunk's A2A and
+        the last chunk's combine are outside the overlap window);
+      - more chunks -> worse per-chunk GEMM: mxu_efficiency of the
+        shrinking row count, plus the expert weight stacks re-streamed
+        from HBM once per chunk when they exceed VMEM residence.
+
+    overlap=False models the same chunked math run sequentially
+    (every chunk pays wire + compute back to back). Ranks candidates for
+    autotuner.prune_ep_moe_configs; does not promise wall-clock."""
+    chip = chip or detect_chip()
+    c = capacity if capacity is not None else m * top_k
+    q = max(1, min(int(n_chunks), c))
+    rows = c / q
+    b_wire = _dtype_bytes(payload_dtype or dtype)
+    b = _dtype_bytes(dtype)
+
+    # wire: dispatch chunk (token payload) and combine chunk (f32 back)
+    ta = estimate_a2a_ms(int(rows * hidden * b_wire), n, chip)
+    tc = estimate_a2a_ms(int(rows * hidden * 4), n, chip)
+
+    # per-chunk grouped FFN over the n received sub-segments
+    t_rows = int(n * rows)
+    compute_ms = 0.0
+    for (mm, nn, kk) in ((t_rows, 2 * inter, hidden),
+                         (t_rows, hidden, inter)):
+        compute_ms += (2.0 * mm * nn * kk) / (
+            chip.bf16_tflops * 1e12 * 0.85 * mxu_efficiency(mm, nn, kk)
+        ) * 1e3
+    w_bytes = e_loc * (hidden * 2 * inter + inter * hidden) * b
+    act_bytes = t_rows * (2 * hidden + 3 * inter) * b
+    mem_ms = (w_bytes + act_bytes) / (chip.hbm_gbps * 1e9) * 1e3
+    tf = max(compute_ms, mem_ms)
+
+    if not overlap:
+        return q * (ta + tf + tc)
+    # ramp in (first chunk's wire), steady state (per-chunk max
+    # imbalance), ramp out (last chunk's combine)
+    return ta + q * max(ta, tf) + tc
+
+
+def choose_ep_chunks(
+    m: int,
+    hidden: int,
+    inter: int,
+    e_loc: int,
+    n: int,
+    top_k: int,
+    capacity: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    payload_dtype=None,
+    chip: Optional[ChipSpec] = None,
+    candidates=(1, 2, 4, 8, 16),
+    overlap: bool = False,
+) -> int:
+    """Model-picked chunk count for ep_moe_fwd: the candidate divisor of
+    `capacity` minimizing the pipeline roofline.
+
+    `overlap` must describe the composition that actually RUNS.
+    The default False models today's execution, where the chunked
+    transport kernel completes before the per-chunk FFNs start (the
+    per-chunk delivery semaphores are kernel-internal; cross-kernel
+    overlap needs semaphore-carrying outputs — see docs/performance.md),
+    so every chunk pays wire + compute back to back and extra chunks
+    can only add per-chunk GEMM and weight-restream cost: the pick
+    degenerates to 1. overlap=True scores the true pipeline (the
+    in-kernel-consumer target) where chunking shrinks the exposed ramp
+    on comm-heavy multi-rank shapes. Picking overlap=True for a
+    composition that does not overlap is a model-driven SLOWDOWN —
+    q-fold MXU-efficiency and weight-traffic penalties hiding nothing."""
+    c = capacity if capacity is not None else m * top_k
+    live = [q for q in candidates if q <= c and c % q == 0] or [1]
+    return min(live, key=lambda q: estimate_ep_moe_ms(
+        m, hidden, inter, e_loc, n, top_k, capacity=c, n_chunks=q,
+        dtype=dtype, payload_dtype=payload_dtype, chip=chip,
+        overlap=overlap,
+    ))
+
+
 def estimate_ag_gemm_ms(
     m: int,
     k: int,
