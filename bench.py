@@ -30,6 +30,13 @@ meaningless; we time k-iteration data-dependent chains inside one jit and
 difference two chain lengths. t_hi <= t_lo is treated as a measurement
 failure and retried, never clamped (round-2 ADVICE: a clamp could silently
 report a perfect 0.0).
+
+`--trace` (opt-in; see docs/observability.md): re-runs the ag_gemm and
+EP-MoE arms with trace.building() active, writes one Perfetto JSON per
+arm under --trace-dir (default ./traces), and measures the tracing
+overhead on the ag_gemm kernel arm — `overhead_frac` (traced/untraced
+chain time - 1) is HARD-ASSERTED < 0.03 so instrumentation can never
+silently tax the kernels it observes.
 """
 
 import json
@@ -618,13 +625,119 @@ def bench_sp_decode_partial(mesh):
     return r, pm * 1e3, xm * 1e3
 
 
+TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
+
+
+def bench_trace_overhead(mesh, x, w1, k_hi=41, pairs=7):
+    """Tracing overhead on the forced ag_gemm kernel arm: the identical
+    chain timed with and without an active trace build. Returns
+    (overhead_frac, traced_ms, untraced_ms); overhead_frac is
+    hard-asserted < TRACE_OVERHEAD_CEIL — the zero-cost-when-off
+    contract's measured complement (cheap-when-on)."""
+    from triton_dist_tpu import trace
+
+    cfg = AgGemmConfig(256, 3200, 512)
+
+    def build(traced):
+        def bld(k):
+            def per_rank(x, w1):
+                m_loc = x.shape[0]
+
+                def body(_, c):
+                    res = ag_gemm(c, w1, axis="tp", config=cfg,
+                                  force_kernel=True, c_order="arrival")
+                    h = res[0] if traced else res
+                    h = jax.lax.optimization_barrier(h)
+                    return h[:m_loc, :HIDDEN].astype(c.dtype)
+
+                out = jax.lax.fori_loop(0, k, body, x)
+                return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+            return jax.jit(
+                jax.shard_map(
+                    per_rank, mesh=mesh,
+                    in_specs=(P("tp"), P(None, "tp")),
+                    out_specs=P("tp"), check_vma=False,
+                )
+            )
+
+        return bld
+
+    ms, _ = _chain_timer(build(False), (x, w1), k_hi=k_hi, pairs=pairs)
+    with trace.building(cap=512):
+        tr_ms, _ = _chain_timer(build(True), (x, w1), k_hi=k_hi,
+                                pairs=pairs)
+    frac = tr_ms / ms - 1.0
+    assert frac < TRACE_OVERHEAD_CEIL, (
+        f"tracing overhead {frac:.4f} exceeds the "
+        f"{TRACE_OVERHEAD_CEIL} ceiling on the ag_gemm arm "
+        f"({tr_ms:.4f} vs {ms:.4f} ms)")
+    return frac, tr_ms, ms
+
+
+def write_arm_traces(mesh, x, w1, out_dir):
+    """One traced execution per arm -> one Perfetto JSON per arm."""
+    import numpy as _np
+
+    from triton_dist_tpu import trace
+    from triton_dist_tpu.layers import EPMoEParams, ep_moe_fwd
+
+    wrote = {}
+    world = mesh.devices.size
+    with trace.tracing("ag_gemm", cap=1024) as (build, sess):
+        fn = jax.jit(jax.shard_map(
+            lambda x, w: ag_gemm(x, w, axis="tp",
+                                 config=AgGemmConfig(256, 3200, 512),
+                                 force_kernel=True, c_order="arrival"),
+            mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=(P(None, "tp"), P("tp")), check_vma=False,
+        ))
+        with sess.host_span("ag_gemm"):
+            _, tbuf = jax.block_until_ready(fn(x, w1))
+        tl = sess.assemble({"ag_gemm": _np.asarray(tbuf).reshape(
+            world, -1, trace.RECORD_WORDS)})
+        wrote["ag_gemm"] = trace.write_trace(
+            tl, f"{out_dir}/ag_gemm.trace.json")
+
+    M_, H_, K_, E_, I_ = 128, 1024, 4, 8, 512
+    rng = np.random.default_rng(11)
+    dt = jnp.bfloat16
+    xs = jnp.asarray(rng.standard_normal((world * M_, H_)) * 0.1, dt)
+    params = EPMoEParams(
+        jnp.asarray(rng.standard_normal((H_, E_)) * 0.1, jnp.float32),
+        jnp.asarray(rng.standard_normal((E_, H_, 2 * I_)) * 0.02, dt),
+        jnp.asarray(rng.standard_normal((E_, I_, H_)) * 0.02, dt),
+    )
+    with trace.tracing("ep_moe", cap=1024) as (build, sess):
+        specs = (P("tp"), EPMoEParams(P(), P("tp"), P("tp")))
+        tspec = {"ep.dispatch.a2a": P("tp"), "ep.ffn": P("tp"),
+                 "ep.combine.a2a": P("tp")}
+        fn = jax.jit(jax.shard_map(
+            lambda x, p: ep_moe_fwd(x, p, K_, axis="tp", overlap=True,
+                                    n_chunks=2),
+            mesh=mesh, in_specs=specs, out_specs=(P("tp"), tspec),
+            check_vma=False,
+        ))
+        with sess.host_span("ep_moe"):
+            _, traces = jax.block_until_ready(fn(xs, params))
+        tl = sess.assemble({k: _np.asarray(v).reshape(
+            world, -1, trace.RECORD_WORDS) for k, v in traces.items()})
+        wrote["ep_moe"] = trace.write_trace(
+            tl, f"{out_dir}/ep_moe.trace.json")
+    return wrote
+
+
 # Driver-facing result schema. The driver tracks metric trends by key
 # name across rounds, so a typo'd, renamed, or non-finite baseline field
 # silently breaks the trend without failing anything — check_result makes
 # that a nonzero exit instead (CI catches metric drift).
 _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 _STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
-                "gemm_rs_tuned_cfg"}
+                "gemm_rs_tuned_cfg", "trace_dir"}
+# signed numerics: legitimately negative (an overhead measurement can
+# read slightly below zero in chain-timer noise) — exempt from the
+# `v < 0` malformed-value rule, never from finiteness
+_SIGNED_KEYS = {"overhead_frac"}
 _NUMERIC_KEYS = {
     "value", "vs_baseline",
     "mega_8b_hbm_floor_ms", "mega_8b_gap_vs_floor",
@@ -639,6 +752,7 @@ _NUMERIC_KEYS = {
     "a2a_dispatch_us",
     "ep_moe_fwd_us", "ep_moe_seq_us", "ep_moe_xla_us",
     "ep_moe_overlap_vs_seq", "ep_moe_chunks", "ep_moe_drop_frac",
+    "overhead_frac",
 }
 _OTHER_KEYS = {"raw"}  # free-form chain timings
 
@@ -660,7 +774,9 @@ def check_result(result: dict) -> list:
         elif k in _NUMERIC_KEYS:
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 problems.append(f"{k!r} must be numeric, got {type(v)}")
-            elif not math.isfinite(v) or (v < 0 and not failed):
+            elif not math.isfinite(v) or (
+                v < 0 and not failed and k not in _SIGNED_KEYS
+            ):
                 problems.append(f"{k!r} has malformed value {v!r}")
         elif k in _STRING_KEYS:
             if not isinstance(v, str):
@@ -783,6 +899,35 @@ def main():
         result.update(bench_ep_moe(mesh))
     except Exception as e:
         result["ep_moe_error"] = str(e)[:200]
+
+    if "--trace" in sys.argv:
+        # opt-in observability pass (never on the driver's default path):
+        # a Perfetto JSON per arm + the instrumentation-overhead guard.
+        # The overhead assert is a HARD failure by design — tracing that
+        # taxes the kernels > 3% must not ship silently.
+        import os
+
+        out_dir = os.environ.get("TDT_TRACE_DIR", "traces")
+        if "--trace-dir" in sys.argv:
+            idx = sys.argv.index("--trace-dir")
+            if idx + 1 >= len(sys.argv):
+                print("bench.py: --trace-dir requires a value",
+                      file=sys.stderr)
+                sys.exit(2)
+            out_dir = sys.argv[idx + 1]
+        rng = np.random.default_rng(0)
+        xt = jnp.asarray(
+            rng.standard_normal((M, HIDDEN)) * 0.02, jnp.bfloat16)
+        w1t = jnp.asarray(
+            rng.standard_normal((HIDDEN, N_GATE_UP * world)) * 0.02,
+            jnp.bfloat16)
+        frac, tr_ms, un_ms = bench_trace_overhead(mesh, xt, w1t)
+        result["overhead_frac"] = round(frac, 4)
+        wrote = write_arm_traces(mesh, xt, w1t, out_dir)
+        result["trace_dir"] = out_dir
+        print(f"bench.py --trace: wrote {sorted(wrote.values())}; "
+              f"overhead_frac={frac:.4f} "
+              f"({tr_ms:.4f} vs {un_ms:.4f} ms)", file=sys.stderr)
 
     _emit(result)
 

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""trace_report — per-region attribution + predicted-stall diff from an
+exported trace JSON.
+
+Usage:
+    python scripts/trace_report.py TRACE.json [TRACE2.json ...]
+
+Reads Perfetto/Chrome-trace JSONs written by `trace.write_trace`
+(examples/12_trace_overlap.py, `bench.py --trace`), prints:
+
+  * per-stream attribution: compute / sem_wait / dma_wait fractions of
+    the traced span time (from the events' `cat` classification);
+  * a per-region table (total span time + span/instant counts);
+  * for megakernel traces that embedded an `attribution.
+    compare_predicted` report (otherData["compare_predicted"]), the
+    measured-vs-predicted scoreboard-stall diff per (rank, queue).
+
+Exits non-zero on a malformed trace (missing magic format tag, events
+without ph/pid/ts) — the same strictness contract as bench.check_result:
+a tool that silently renders a clobbered trace would hide exactly the
+bugs the trace exists to catch.
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import defaultdict
+
+# runnable from anywhere: the repo root is the package root
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from triton_dist_tpu.trace.collect import MalformedTrace  # noqa: E402
+from triton_dist_tpu.trace.export import load_trace_json  # noqa: E402
+
+CLASSES = ("compute", "sem_wait", "dma_wait")
+
+
+def report(path: str) -> None:
+    d = load_trace_json(path)
+    events = d["traceEvents"]
+    pname = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname[e["pid"]] = e["args"]["name"]
+
+    by_stream = defaultdict(lambda: defaultdict(float))
+    by_region = defaultdict(lambda: [0.0, 0, 0])  # time, spans, instants
+    for e in events:
+        stream = pname.get(e.get("pid"), str(e.get("pid")))
+        region = str(e.get("name", "?")).split(" ")[0]
+        if e.get("ph") == "X":
+            cat = e.get("cat", "trace")
+            dur = float(e.get("dur", 0.0))
+            if cat in CLASSES:
+                by_stream[stream][cat] += dur
+            by_stream[stream]["total"] += dur
+            r = by_region[(stream, region)]
+            r[0] += dur
+            r[1] += 1
+        elif e.get("ph") == "i":
+            by_region[(stream, region)][2] += 1
+
+    print(f"== {path} ({d['otherData'].get('label', '?')}, "
+          f"clock={d['otherData'].get('clock', '?')}) ==")
+    drops = d["otherData"].get("drops") or {}
+    if any(drops.values()):
+        print(f"  WARNING: dropped records: {drops}")
+    print(f"{'stream':<20} {'compute':>9} {'sem_wait':>9} "
+          f"{'dma_wait':>9}")
+    for stream in sorted(by_stream):
+        tot = max(by_stream[stream]["total"], 1e-9)
+        print(f"{stream:<20} " + " ".join(
+            f"{by_stream[stream][c] / tot:>8.1%}" for c in CLASSES))
+    print()
+    print(f"{'stream/region':<28} {'time_us':>10} {'spans':>7} "
+          f"{'instants':>9}")
+    for (stream, region), (t, ns, ni) in sorted(by_region.items()):
+        print(f"{stream + '/' + region:<28} {t:>10.1f} {ns:>7} {ni:>9}")
+
+    rep = d["otherData"].get("compare_predicted")
+    if rep:
+        print()
+        print("measured vs predicted scoreboard stall "
+              "(mega/scheduler.predicted_stalls):")
+        print(f"{'rank':>4} {'queue':>5} {'tasks':>6} "
+              f"{'measured_frac':>14} {'predicted_frac':>15} {'ok':>3}")
+        for row in rep:
+            m = row["measured_stall_frac"]
+            p = row["predicted_stall_frac"]
+            ok = (p is not None and abs(m - p) <= 0.1
+                  and row["n_tasks_traced"] == row["n_tasks_scheduled"]
+                  and row["order_ok"])
+            print(f"{str(row.get('rank')):>4} {row['queue']:>5} "
+                  f"{row['n_tasks_traced']:>6} {m:>14.3f} "
+                  f"{p if p is None else round(p, 3)!s:>15} "
+                  f"{'ok' if ok else 'NO':>3}")
+            if not ok:
+                raise MalformedTrace(
+                    f"{path}: rank {row.get('rank')} queue "
+                    f"{row['queue']} disagrees with the schedule")
+    print()
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        for path in argv:
+            report(path)
+    except MalformedTrace as e:
+        print(f"trace_report: malformed trace: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

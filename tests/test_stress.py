@@ -99,6 +99,50 @@ def test_gemm_rs_under_stragglers(mesh):
         )
 
 
+def test_ag_gemm_traced_under_straggler(mesh):
+    """ISSUE-3: the trace instrumentation must survive the straggler
+    stress (same correctness bar as the untraced runs) and record the
+    protocol's structure — every rank's ring-step waits and exactly one
+    skew instant per rank, with the injected delay attributed to the
+    delayed rank alone."""
+    from triton_dist_tpu import trace
+
+    a, b = _data(5)
+    cfg = AgGemmConfig(tile_m=64, tile_n=128, tile_k=128,
+                       straggler_rank=2, straggler_ns=DELAY_NS)
+    ref = jax.jit(jax.shard_map(
+        lambda a, b: ag_gemm_ref(a, b, axis="tp"),
+        mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+        out_specs=P(None, "tp"), check_vma=False,
+    ))(a, b)
+    with trace.tracing("ag_stress", cap=512) as (build, sess):
+        out, tbuf = jax.jit(jax.shard_map(
+            lambda a, b: ag_gemm(a, b, axis="tp", config=cfg,
+                                 force_kernel=True),
+            mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=(P(None, "tp"), P("tp")), check_vma=False,
+        ))(a, b)
+        tl = sess.assemble({"ag": np.asarray(tbuf).reshape(
+            N, -1, trace.RECORD_WORDS)})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    for q in range(N):
+        # one ring wait per remote step, in step order
+        steps = [s.payload for s in sorted(
+            tl.spans_of("ag", rank=q, region="ag.ring_wait"),
+            key=lambda s: s.t0)]
+        assert steps == list(range(1, N))
+        # per-tile output instants cover the whole grid
+        tiles = [e for e in tl.select("ag", rank=q)
+                 if e.region == trace.REGIONS["ag.tile"]]
+        assert len(tiles) == N  # mt*nt tiles per step at this tiling
+    skews = [e for e in tl.events
+             if e.region == trace.REGIONS["straggle"]]
+    assert len(skews) == N
+    assert sorted(e.payload for e in skews) == [0] * (N - 1) + [DELAY_NS]
+    assert next(e.rank for e in skews if e.payload) == 2
+
+
 def test_ag_gemm_all_ranks_random_stragglers(mesh):
     """for_correctness analog (ref allgather.py:74-78): random rank and
     random delay every iteration, many iterations back-to-back in one jit

@@ -1,0 +1,325 @@
+"""trace subsystem tests (ISSUE 3): record round-trip, zero-cost-off
+bit-identity, megakernel measured-vs-predicted, export strictness.
+
+The skew-visibility test for the chunked A2A lives with the other A2A
+coverage in tests/test_p2p_a2a.py; the traced straggler stress run in
+tests/test_stress.py.
+"""
+
+import functools
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu import trace
+from triton_dist_tpu.kernels import all_to_all_chunked, all_to_all_ref
+from triton_dist_tpu.lang.core import pallas_call_count
+from triton_dist_tpu.trace import events as ev
+from triton_dist_tpu.trace.collect import Event, MalformedTrace, Span
+
+N_DEV = 8
+W = trace.RECORD_WORDS
+
+
+def _make(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray((rng.standard_normal(shape) * 0.1).astype(
+        np.float32))
+
+
+# ---------- record format / collector units ----------
+
+
+def test_mark_stream_roundtrip():
+    b = trace.TraceBuild(cap=8)
+    s = trace.new_stream(b, stream=1, rank=3)
+    s = trace.mark(s, ev.REGIONS["ep.phase"], ev.KIND_BEGIN, payload=7)
+    s = trace.mark(s, ev.REGIONS["ep.ffn_chunk"], payload=1, aux=2,
+                   token=jnp.float32(9.5))
+    s = trace.mark(s, ev.REGIONS["ep.phase"], ev.KIND_END, payload=7)
+    tl = trace.assemble({"m": np.asarray(s)})
+    assert [e.region for e in tl.events] == [
+        ev.REGIONS["ep.phase"], ev.REGIONS["ep.ffn_chunk"],
+        ev.REGIONS["ep.phase"]]
+    assert tl.events[0].rank == 3
+    assert [e.seq for e in tl.events] == [0, 1, 2]
+    (sp,) = tl.spans
+    assert (sp.payload, sp.t0, sp.t1) == (7, 0.0, 2.0)
+    # the token rides as a zero: payload must be exactly what was given
+    assert tl.events[1].payload == 1 and tl.events[1].aux == 2
+
+
+def test_mark_stream_saturates_and_counts_drops():
+    b = trace.TraceBuild(cap=2)
+    s = trace.new_stream(b)
+    for i in range(5):
+        s = trace.mark(s, ev.REGIONS["ep.phase"], payload=i)
+    tl = trace.assemble({"m": np.asarray(s)})
+    assert len(tl.events) == 2  # saturating buffer: prefix kept
+    assert [e.payload for e in tl.events] == [0, 1]
+    assert tl.drops[("m", -1, 0)] == 3
+
+
+def test_malformed_buffer_rejected():
+    b = trace.TraceBuild(cap=4)
+    s = np.asarray(trace.new_stream(b)).copy()
+    s[0, 0] = 0  # clobber the magic
+    with pytest.raises(MalformedTrace, match="magic"):
+        trace.assemble({"m": s})
+    # END without BEGIN is structural corruption, not drop fallout
+    s2 = trace.new_stream(b)
+    s2 = trace.mark(s2, ev.REGIONS["ep.phase"], ev.KIND_END, payload=1)
+    with pytest.raises(MalformedTrace, match="END without BEGIN"):
+        trace.assemble({"m": np.asarray(s2)})
+
+
+def test_virtual_time_applies_straggle_payload():
+    b = trace.TraceBuild(cap=8)
+    s = trace.new_stream(b)
+    s = trace.mark(s, ev.REGIONS["a2a.send"], payload=1)
+    s = trace.mark(s, ev.REGIONS["straggle"], payload=1000)
+    s = trace.mark(s, ev.REGIONS["a2a.send"], payload=2)
+    tl = trace.assemble({"m": np.asarray(s)})
+    # one tick per record; the straggle instant shifts LATER events only
+    assert [e.t for e in tl.events] == [0.0, 1.0, 1002.0]
+
+
+def test_chrome_export_strictness(tmp_path):
+    b = trace.TraceBuild(cap=8)
+    s = trace.new_stream(b, rank=0)
+    s = trace.mark(s, ev.REGIONS["ep.phase"], ev.KIND_BEGIN, payload=1)
+    s = trace.mark(s, ev.REGIONS["ep.phase"], ev.KIND_END, payload=1)
+    sess = trace.TraceSession("unit")
+    with sess.host_span("unit"):
+        pass
+    tl = sess.assemble({"unit": np.asarray(s)})
+    p = str(tmp_path / "t.trace.json")
+    trace.write_trace(tl, p, extra={"compare_predicted": []})
+    d = trace.load_trace_json(p)
+    phases = {e["ph"] for e in d["traceEvents"]}
+    assert "X" in phases and "M" in phases
+    assert d["otherData"]["compare_predicted"] == []
+    # malformed on-disk trace is a hard error (trace_report exit-1 path)
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{}")
+    with pytest.raises(MalformedTrace):
+        trace.load_trace_json(bad)
+
+
+# ---------- zero cost when off (tentpole contract) ----------
+
+
+def _run_a2a(fn, mesh8, x, splits, out_specs=(P("tp"), P("tp"))):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh8, in_specs=(P("tp"), P("tp")),
+            out_specs=out_specs, check_vma=False,
+        )
+    )(x, splits)
+
+
+def test_zero_cost_when_off(mesh8):
+    """Instrumented kernels built WITHOUT tracing: unchanged
+    pallas_call_count, byte-identical outputs to the XLA oracle AND to
+    the traced build's primary outputs."""
+    n, m, h = N_DEV, 4, 128
+    x = _make((n * n, m, h), seed=41)
+    splits = jnp.asarray(
+        np.random.default_rng(1).integers(0, m + 1, (n * n,)), np.int32)
+    ref_o, ref_s = _run_a2a(
+        functools.partial(all_to_all_ref, axis="tp"), mesh8, x, splits)
+
+    assert trace.active_build() is None  # default: tracing off
+    before = pallas_call_count()
+    off_o, off_s = _run_a2a(
+        functools.partial(all_to_all_chunked, axis="tp", n_chunks=2),
+        mesh8, x, splits)
+    off_calls = pallas_call_count() - before
+
+    with trace.building(cap=256):
+        before = pallas_call_count()
+        on_o, on_s, tbuf = _run_a2a(
+            functools.partial(all_to_all_chunked, axis="tp", n_chunks=2),
+            mesh8, x, splits, out_specs=(P("tp"), P("tp"), P("tp")))
+        on_calls = pallas_call_count() - before
+
+    np.testing.assert_array_equal(np.asarray(off_o), np.asarray(ref_o))
+    np.testing.assert_array_equal(np.asarray(off_s), np.asarray(ref_s))
+    # tracing is observation-only: primary outputs bitwise-unchanged
+    np.testing.assert_array_equal(np.asarray(on_o), np.asarray(off_o))
+    np.testing.assert_array_equal(np.asarray(on_s), np.asarray(off_s))
+    # the instrumentation rides inside the SAME single pallas_call
+    assert off_calls == 1 and on_calls == 1
+    # ... and the build flag is restored after the with-block
+    assert trace.active_build() is None
+
+    tl = trace.assemble({"a2a": np.asarray(tbuf).reshape(n, -1, W)})
+    assert tl.ranks("a2a") == list(range(n))
+    # chunk-major waits: (n-1) remote steps x 2 chunks per rank
+    for q in range(n):
+        assert len(tl.spans_of("a2a", rank=q, region="a2a.wait")) \
+            == (n - 1) * 2
+        assert len(tl.spans_of("a2a", rank=q, region="a2a.local")) == 2
+
+
+def test_trace_cap_saturation_tolerated(mesh8):
+    """A cap smaller than the record count must drop (counted), not
+    corrupt — and pairing stays tolerant because drops explain the
+    unclosed BEGINs."""
+    n, m, h = N_DEV, 4, 128
+    x = _make((n * n, m, h), seed=43)
+    splits = jnp.zeros((n * n,), jnp.int32)
+    with trace.building(cap=7):
+        _o, _s, tbuf = _run_a2a(
+            functools.partial(all_to_all_chunked, axis="tp", n_chunks=2),
+            mesh8, x, splits, out_specs=(P("tp"), P("tp"), P("tp")))
+    tl = trace.assemble({"a2a": np.asarray(tbuf).reshape(n, -1, W)})
+    assert all(v > 0 for v in tl.drops.values())
+    assert all(len(tl.select("a2a", rank=q)) == 7 for q in range(n))
+
+
+def test_composite_layers_build_safe(mesh8):
+    """Layers that COMPOSE instrumented kernels (tp_mlp's ag_gemm ->
+    gemm_rs chain) must keep working inside trace.building() — the
+    extra trailing trace outputs are stripped via trace.primary, not
+    fed into the next kernel as data."""
+    from triton_dist_tpu.layers import TPMLPParams, tp_mlp_dist_fwd
+
+    n, m, h, i = N_DEV, 64, 128, 256
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((m, h)) * 0.1, jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((h, i)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((h, i)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((i, h)) * 0.1, jnp.float32)
+
+    def run():
+        return jax.jit(jax.shard_map(
+            lambda x, wg, wu, w2: tp_mlp_dist_fwd(
+                x, TPMLPParams(wg, wu, w2), axis="tp"),
+            mesh=mesh8,
+            in_specs=(P("tp"), P(None, "tp"), P(None, "tp"),
+                      P("tp", None)),
+            out_specs=P("tp"), check_vma=False,
+        ))(x, wg, wu, w2)
+
+    base = run()
+    with trace.building(cap=128):
+        traced = run()
+    np.testing.assert_array_equal(np.asarray(traced), np.asarray(base))
+
+
+# ---------- megakernel: measured vs predicted ----------
+
+
+def test_mega_trace_compare_predicted():
+    """Traced megakernel decode: logits bitwise equal to the untraced
+    build, every scheduled task covered in order, measured scoreboard
+    stall agrees with predicted_stalls (exactly 0 == 0 on the
+    single-queue deterministic clock), prefetch instants present, and
+    the export is Perfetto-loadable."""
+    from triton_dist_tpu.mega.qwen3 import MegaQwen3
+    from triton_dist_tpu.models import ModelConfig
+    from triton_dist_tpu.runtime import make_mesh
+
+    tp = 2
+    mesh = make_mesh((tp,), ("tp",))
+    cfg = ModelConfig.tiny(max_positions=16, num_q_heads=2 * tp,
+                           num_kv_heads=tp)
+    base = MegaQwen3(cfg, mesh, batch=1, s_max=16, fast_init=True,
+                     donate_cache=False, seed=3)
+    l0, _ = base.decode_step(jnp.zeros((1,), jnp.int32),
+                             base.new_cache())
+
+    with trace.tracing("mega", cap=4096) as (build, sess):
+        mega = MegaQwen3(cfg, mesh, batch=1, s_max=16, fast_init=True,
+                         donate_cache=False, seed=3)
+        logits, _cache, tbuf = mega.decode_step(
+            jnp.zeros((1,), jnp.int32), mega.new_cache())
+        nc = mega.sched.num_cores
+        tl = sess.assemble({"mega": np.asarray(tbuf).reshape(
+            tp, nc, -1, W)})
+    np.testing.assert_array_equal(np.asarray(logits), np.asarray(l0))
+
+    rep = trace.compare_predicted(mega.sched, tl, graph=mega.graph,
+                                  tol=0.1)
+    assert len(rep) == tp * nc
+    for row in rep:
+        assert row["n_tasks_traced"] == row["n_tasks_scheduled"]
+        assert row["order_ok"]
+        assert row["measured_stall"] == 0.0
+        assert row["predicted_stall"] == 0.0
+    assert trace.prefetch_hit_rate(tl) == 1.0
+
+
+def test_compare_predicted_rejects_divergence():
+    """The diff must FAIL on a trace that does not match the schedule —
+    wrong task count, and stall fractions beyond tolerance."""
+    R = ev.REGIONS["mega.task"]
+    SB = ev.REGIONS["mega.sb_wait"]
+
+    def span(region, lane, t0, t1, payload=0, aux=0):
+        return Span("mega", 0, lane, region, payload, aux, t0, t1)
+
+    def event(lane, seq, t):
+        return Event("mega", 0, lane, R, ev.KIND_BEGIN, seq, 0, 0, t)
+
+    sched = types.SimpleNamespace(queues=[[0, 1], [2]],
+                                  stall=np.array([0.0, 2.0]))
+    graph = types.SimpleNamespace(
+        tasks=[types.SimpleNamespace(cost=1.0)] * 3)
+    good = trace.Timeline(
+        events=[event(0, 0, 0.0)],
+        spans=[span(R, 0, 0, 1, aux=0), span(R, 0, 2, 3, aux=1),
+               span(R, 1, 0, 1, aux=0), span(SB, 1, 1, 3)],
+        drops={}, host_spans=[])
+    rep = trace.compare_predicted(sched, good, graph=graph, tol=0.1)
+    assert rep[1]["measured_stall_frac"] == pytest.approx(2 / 3)
+
+    # missing task span -> coverage failure
+    bad_cov = trace.Timeline(events=[event(0, 0, 0.0)],
+                             spans=good.spans[1:], drops={},
+                             host_spans=[])
+    with pytest.raises(AssertionError, match="does not cover"):
+        trace.compare_predicted(sched, bad_cov, graph=graph)
+
+    # stall fraction off by >> tol -> disagreement failure
+    bad_stall = trace.Timeline(
+        events=[event(0, 0, 0.0)],
+        spans=[span(R, 0, 0, 1, aux=0), span(R, 0, 2, 3, aux=1),
+               span(R, 1, 0, 1, aux=0)],
+        drops={}, host_spans=[])
+    with pytest.raises(AssertionError, match="stall fraction"):
+        trace.compare_predicted(sched, bad_stall, graph=graph, tol=0.1)
+
+
+# ---------- satellites: dedup + bench schema ----------
+
+
+def test_runtime_utils_profiling_aliases():
+    """ONE trace-merging code path: runtime.utils re-exports the
+    trace/export implementations."""
+    from triton_dist_tpu.runtime import utils as ru
+    from triton_dist_tpu.trace import export as tx
+
+    assert ru.group_profile is tx.group_profile
+    assert ru.merge_traces is tx.merge_traces
+
+
+def test_bench_schema_overhead_frac():
+    import bench
+
+    base = {"metric": "m", "value": 1.0, "unit": "ms",
+            "vs_baseline": 1.0}
+    # overhead_frac is a known signed numeric: tiny negative readings
+    # are chain-timer noise, not malformed results
+    assert bench.check_result({**base, "overhead_frac": -0.004}) == []
+    assert bench.check_result({**base, "overhead_frac": 0.01,
+                               "trace_dir": "traces"}) == []
+    # but non-finite and unknown keys still fail
+    assert bench.check_result({**base, "overhead_frac": float("nan")})
+    assert bench.check_result({**base, "overheadfrac_typo": 0.1})

@@ -16,6 +16,9 @@ Layer map (mirrors reference SURVEY.md table; reference = Triton-distributed):
                (ref: python/triton_dist/language/, libshmem_device)
   kernels/   - overlapping collective + compute kernels
                (ref: python/triton_dist/kernels/nvidia/)
+  trace/     - in-kernel event tracing, stall attribution, Perfetto
+               export (ref: the intra-kernel profiler hooks;
+               docs/observability.md)
 Subpackages under construction land here as they are built (layers/,
 models/, megakernel/, tools/, csrc/ in the reference's inventory).
 """

@@ -37,6 +37,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import EP_AXIS
+from triton_dist_tpu.trace import events as trace_ev
 
 
 def _a2a_kernel(axis: str, n: int, x_ref, s_ref, o_ref, os_ref,
@@ -152,9 +153,7 @@ def all_to_all_ref(x: jax.Array, splits: jax.Array, axis: str = EP_AXIS):
 # -- chunked transport (the EP MoE pipeline's arrival-granular A2A) ----------
 
 
-def _a2a_chunked_kernel(axis, n, q, rows, straggler, x_ref, s_ref, o_ref,
-                        os_ref, cp_sem, send_sem, recv_sems, meta_send_sem,
-                        meta_recv_sem):
+def _a2a_chunked_kernel(axis, n, q, rows, straggler, build, *refs):
     """Chunk-granular A2A: segment payloads travel as `q` row-chunks, and
     chunk (step i, c) lands on its OWN delivery semaphore slot
     recv_sems[i, c] — the TPU analog of the reference's per-peer
@@ -166,13 +165,32 @@ def _a2a_chunked_kernel(axis, n, q, rows, straggler, x_ref, s_ref, o_ref,
     absolute source rank: every rank's descriptor for step (i, c) then
     names the same static slot, which is what both the hardware DMA
     (slot on the destination chip) and the legacy interpreter's lockstep
-    discharge (slot on the local instance) require to agree."""
+    discharge (slot on the local instance) require to agree.
+
+    `build` (trace.events.TraceBuild or None) gates the event records:
+    instants per chunk send, spans per delivery wait, and the straggle
+    instant every rank emits (payload = this rank's injected delay, 0
+    off-rank — uniform record sequences keep cross-rank seq aligned for
+    the delivery replay, trace/attribution.a2a_step_waits)."""
+    if build is not None:
+        (x_ref, s_ref, o_ref, os_ref, tbuf, cp_sem, send_sem, recv_sems,
+         meta_send_sem, meta_recv_sem, tcur) = refs
+    else:
+        (x_ref, s_ref, o_ref, os_ref, cp_sem, send_sem, recv_sems,
+         meta_send_sem, meta_recv_sem) = refs
+        tbuf = tcur = None
     me = jax.lax.axis_index(axis)
+    tctx = trace_ev.make_ctx(build, tbuf, tcur)
+    trace_ev.init_ctx(tctx, rank=me)
+    R = trace_ev.REGIONS
     shmem.barrier_all(axis)
     if straggler is not None:
         # race provocation: stall one rank between entering the kernel
         # and issuing its sends, so its peers' per-chunk waits really
         # wait (pattern of the megakernel AR skew stress)
+        trace_ev.instant(
+            tctx, R["straggle"],
+            payload=jnp.where(me == straggler[0], straggler[1], 0))
         shmem.straggler_delay(axis, straggler[0], straggler[1])
 
     # Local segment: chunk-granular local copies, each on its own slot
@@ -204,6 +222,7 @@ def _a2a_chunked_kernel(axis, n, q, rows, straggler, x_ref, s_ref, o_ref,
                 device_id={axis: peer},
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
+            trace_ev.instant(tctx, R["a2a.send"], payload=i, aux=c)
             rdma.start()
             handles[(i, c)] = rdma
         meta = pltpu.make_async_remote_copy(
@@ -221,13 +240,16 @@ def _a2a_chunked_kernel(axis, n, q, rows, straggler, x_ref, s_ref, o_ref,
     # c are complete FROM EVERY SOURCE while chunks c+1.. are still in
     # flight — the wait order a fused consumer interleaves compute into.
     for c in range(q):
-        local[c].wait()
+        with trace_ev.span(tctx, R["a2a.local"], payload=c):
+            local[c].wait()
         for i in range(1, n):
-            handles[(i, c)].wait()
+            with trace_ev.span(tctx, R["a2a.wait"], payload=i, aux=c):
+                handles[(i, c)].wait()
     cps.start()
     cps.wait()
-    for h in meta_handles:
-        h.wait()
+    for i, h in enumerate(meta_handles):
+        with trace_ev.span(tctx, R["a2a.meta"], payload=i + 1):
+            h.wait()
 
 
 def all_to_all_chunked(
@@ -246,6 +268,10 @@ def all_to_all_chunked(
 
     x: (n, C, hidden) with C % n_chunks == 0; splits: (n,) or (n, S).
     straggler: optional (rank, nanos) skew injection for stress tests.
+
+    Tracing (trace.building active): returns a THIRD output — the
+    per-rank device trace buffer — on every path (fallbacks hand back an
+    empty buffer), so callers' output trees are build-stable.
     """
     n = jax.lax.axis_size(axis)
     if x.shape[0] != n:
@@ -256,36 +282,51 @@ def all_to_all_chunked(
             f"n_chunks={q} must be >= 1 and divide the capacity dim "
             f"{x.shape[1]}"
         )
+    build = trace_ev.active_build()
+
+    def with_trace(res, tbuf=None):
+        return trace_ev.with_trace(build, res, tbuf)
+
     if n == 1:
-        return x, splits.astype(jnp.int32)
+        return with_trace((x, splits.astype(jnp.int32)))
     if interpret_no_headroom():
-        return all_to_all_ref(x, splits, axis)
+        return with_trace(all_to_all_ref(x, splits, axis))
     rows = x.shape[1] // q
     splits2d = splits.reshape(n, -1).astype(jnp.int32)
-    out, out_splits = tpu_call(
-        functools.partial(_a2a_chunked_kernel, axis, n, q, rows, straggler),
-        out_shape=(
-            jax.ShapeDtypeStruct(x.shape, x.dtype),
-            jax.ShapeDtypeStruct(splits2d.shape, jnp.int32),
-        ),
+    out_shape = (
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        jax.ShapeDtypeStruct(splits2d.shape, jnp.int32),
+    )
+    out_specs = (
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    )
+    scratch = [
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((n, q)),
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+    ]
+    if build is not None:
+        out_shape += (trace_ev.out_shape(build),)
+        out_specs += (trace_ev.out_spec(),)
+        scratch.append(trace_ev.cursor_scratch())
+    res = tpu_call(
+        functools.partial(_a2a_chunked_kernel, axis, n, q, rows,
+                          straggler, build),
+        out_shape=out_shape,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ),
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((n, q)),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True,
             collective_id=next_collective_id(f"a2a_chunk{q}_{axis}"),
         ),
     )(x, splits2d)
-    return out, out_splits.reshape(splits.shape)
+    out, out_splits = res[:2]
+    return with_trace((out, out_splits.reshape(splits.shape)),
+                      res[2] if build is not None else None)

@@ -61,6 +61,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.trace import events as trace_ev
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,24 +101,28 @@ def _silu_mul_f32(g, u):
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
                     need_ws: bool, cache_a: bool, silu_pair: bool,
-                    arrival: bool, grouped: bool, *refs):
+                    arrival: bool, grouped: bool, build, *refs):
     refs = list(refs)
     a_ref, b_ref = refs[:2]
     del refs[:2]
     b2_ref = refs.pop(0) if silu_pair else None
     ws_ref, c_ref = refs[:2]
     del refs[:2]
+    tbuf = refs.pop(0) if build is not None else None
     a_buf = refs.pop(0)
     # nk==1 (full-K tiles) stores the dot straight to the output block:
     # no accumulator scratch is allocated (see the consumer below)
     acc = refs.pop(0) if nk > 1 else None
     acc2 = refs.pop(0) if (silu_pair and nk > 1) else None
     stage = None if arrival else refs.pop(0)
+    tcur = refs.pop() if build is not None else None
     if arrival:
         ld_sems, cp_sem, send_sem, recv_sems = refs
         st_sem = None
     else:
         ld_sems, st_sem, cp_sem, send_sem, recv_sems = refs
+    tctx = trace_ev.make_ctx(build, tbuf, tcur)
+    R = trace_ev.REGIONS
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -172,10 +177,20 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
 
     def a_wait(slot):
         # descriptor only carries the byte count for the semaphore wait
-        pltpu.make_async_copy(
-            ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
-            ld_sems.at[slot],
-        ).wait()
+        with trace_ev.span(tctx, R["ag.a_wait"], payload=flat, aux=s):
+            pltpu.make_async_copy(
+                ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
+                ld_sems.at[slot],
+            ).wait()
+
+    # trace init: the first grid step, before any emit below
+    @pl.when(jnp.logical_and(flat == 0, s == 0))
+    def _trace_init():
+        trace_ev.init_ctx(tctx, rank=me)
+        if straggler[1] > 0:
+            trace_ev.instant(
+                tctx, R["straggle"],
+                payload=jnp.where(me == straggler[0], straggler[1], 0))
 
     # --- producer side: runs once per ring step, before that step's tiles.
     if need_ws:
@@ -210,10 +225,11 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         def _later_steps():
             prev_chunk = jnp.mod(me - s + 1, n)
             prev = fwd_copy(prev_chunk, s - 1)
-            prev.wait_send()
-            # consumer wait: this step's A rows have landed
-            # (the dl.wait/consume_token contract, ref :236-237).
-            prev.wait_recv()
+            with trace_ev.span(tctx, R["ag.ring_wait"], payload=s):
+                prev.wait_send()
+                # consumer wait: this step's A rows have landed
+                # (the dl.wait/consume_token contract, ref :236-237).
+                prev.wait_recv()
 
             @pl.when(s < n - 1)
             def _():
@@ -285,6 +301,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     # --- store the finished output tile.
     @pl.when(kk == nk - 1)
     def _store():
+        trace_ev.instant(tctx, R["ag.tile"], payload=flat, aux=s)
         g = contrib if nk == 1 else acc[...]
         if silu_pair:
             u = contrib2 if nk == 1 else acc2[...]
@@ -351,8 +368,16 @@ def ag_gemm(
     (gemm_rs(a_order="arrival"), the TP-MLP down-proj) indexes chunks by
     arrival slot at zero cost. Use arrival_to_rank_order to un-permute
     for order-sensitive consumers.
+
+    Tracing (trace.building active): one extra trailing output — the
+    device trace buffer (ring-step recv waits, per-tile A-load waits,
+    tile-store instants); fallback paths return an empty buffer.
     """
     cfg = config or AgGemmConfig()
+    build = trace_ev.active_build()
+
+    def with_trace(res, tbuf=None):
+        return trace_ev.with_trace(build, res, tbuf)
     out_dtype = out_dtype or a_shard.dtype
     silu_pair = epilogue == "silu_pair"
     assert epilogue in (None, "silu_pair"), f"unknown epilogue {epilogue}"
@@ -424,7 +449,7 @@ def ag_gemm(
         # (and XLA fuses the silu_pair epilogue into the dot's output for
         # free — measured 0.73 vs 0.80 ms for the two-accumulator Pallas
         # variant at the bench shape, benchmark/sweep_ag_gemm.py).
-        return xla_path()
+        return with_trace(xla_path())
 
     fit = fit_tile  # shared tile-fitting rule (lang.core)
 
@@ -460,7 +485,7 @@ def ag_gemm(
         not force_kernel
     ):
         # Fallback: XLA AG + dot (the reference's torch path analog).
-        return xla_path()
+        return with_trace(xla_path())
 
     need_ws = n > 1 or return_gathered
     grid = (n, mt, nt, nk)
@@ -504,23 +529,30 @@ def ag_gemm(
                      memory_space=pltpu.VMEM)
         if arrival else pl.BlockSpec(memory_space=pl.ANY)
     )
-    ws, c = tpu_call(
+    out_shape = (
+        jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),
+        jax.ShapeDtypeStruct(
+            (n * m_loc, i_loc if silu_pair else n_loc), out_dtype
+        ),
+    )
+    out_specs = (
+        pl.BlockSpec(memory_space=pl.ANY),
+        c_spec,
+    )
+    if build is not None:
+        out_shape += (trace_ev.out_shape(build),)
+        out_specs += (trace_ev.out_spec(),)
+        scratch.append(trace_ev.cursor_scratch())
+    res = tpu_call(
         functools.partial(_ag_gemm_kernel, axis, n, mt, nt, nk,
                           tm, tn, tk, out_dtype,
                           (cfg.straggler_rank, cfg.straggler_ns),
-                          need_ws, cache_a, silu_pair, arrival, grouped),
+                          need_ws, cache_a, silu_pair, arrival, grouped,
+                          build),
         grid=grid,
-        out_shape=(
-            jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),
-            jax.ShapeDtypeStruct(
-                (n * m_loc, i_loc if silu_pair else n_loc), out_dtype
-            ),
-        ),
+        out_shape=out_shape,
         in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            c_spec,
-        ),
+        out_specs=out_specs,
         scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True,
@@ -546,7 +578,9 @@ def ag_gemm(
             remote_bytes=(n - 1) * m_loc * k * itemsize,
         ),
     )(*inputs)
-    return (c, ws) if return_gathered else c
+    ws, c = res[:2]
+    tbuf = res[2] if build is not None else None
+    return with_trace((c, ws) if return_gathered else c, tbuf)
 
 
 def ag_gemm_ref(a_shard: jax.Array, b: jax.Array, axis: str = TP_AXIS):

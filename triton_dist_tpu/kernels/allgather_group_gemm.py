@@ -160,11 +160,15 @@ def fused_ag_moe_up(
     st = min_tile(x_shard.dtype)[0]
     cap = round_up(min(max(capacity, 1), m_tok * k), st)
     pack = pack_by_expert(x_shard, topk_ids, e, cap)
-    act = ag_gemm(
+    from triton_dist_tpu.trace.events import primary
+
+    # primary(): build-safe under trace.building() (buffers dropped; see
+    # tp_mlp.dist_fwd)
+    act = primary(ag_gemm(
         pack.x, (w_gate, w_up), axis=axis, config=config,
         epilogue="silu_pair", c_order="arrival",
         force_kernel=force_kernel, out_dtype=x_shard.dtype,
-    )
+    ))
     act = act.reshape(n, e, cap, w_gate.shape[-1])
     meta = MoEFusedMeta(
         slot_of=jax.lax.all_gather(pack.slot_of, axis),

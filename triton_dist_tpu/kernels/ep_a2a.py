@@ -43,6 +43,7 @@ from triton_dist_tpu.kernels.grouped_gemm import grouped_gemm
 from triton_dist_tpu.kernels.moe_utils import chunk_group_sizes, silu_mul
 from triton_dist_tpu.lang.core import interpret_no_headroom
 from triton_dist_tpu.runtime.init import EP_AXIS
+from triton_dist_tpu.trace import events as trace_ev
 
 
 class EPDispatch(NamedTuple):
@@ -408,16 +409,30 @@ def _a2a_select(transport, n_chunks, straggler):
     """Transport arm of the pipeline: 'chunked' (per-chunk delivery
     semaphores), 'plain' (the single-shot kernel), or 'ref' (the XLA
     collective — the bit-identity oracle: all three move identical
-    bytes, which the overlap parity tests assert)."""
+    bytes, which the overlap parity tests assert).
+
+    Under an active trace build every arm returns (out, splits, tbuf) —
+    the chunked kernel's device buffer, or an empty stream for the
+    untraced arms — so the pipeline's output tree is build-stable."""
     if transport == "chunked":
         return lambda x, s, axis: all_to_all_chunked(
             x, s, axis, n_chunks=n_chunks, straggler=straggler)
     if transport == "plain":
-        return all_to_all  # falls back to the ref itself under
+        base = all_to_all  # falls back to the ref itself under
         # interpret_no_headroom — no second copy of that predicate here
-    if transport == "ref":
-        return all_to_all_ref
-    raise ValueError(f"unknown transport {transport!r}")
+    elif transport == "ref":
+        base = all_to_all_ref
+    else:
+        raise ValueError(f"unknown transport {transport!r}")
+    build = trace_ev.active_build()
+    if build is None:
+        return base
+
+    def traced(x, s, axis):
+        out, sp = base(x, s, axis)
+        return out, sp, trace_ev.new_stream(build)
+
+    return traced
 
 
 def ep_dispatch_chunked(
@@ -435,7 +450,9 @@ def ep_dispatch_chunked(
     """Chunk-pipelined dispatch: expert-sorted pack + chunked A2A. Same
     routing and same drops as ep_dispatch (the capacity cut happens
     before the expert sort); the travelling metadata row is
-    [count, per-expert counts] per destination."""
+    [count, per-expert counts] per destination.
+
+    Under an active trace build returns (dispatch, trace_buf)."""
     n = jax.lax.axis_size(axis)
     h = x.shape[1]
     experts_per_rank = n_experts // n
@@ -445,14 +462,16 @@ def ep_dispatch_chunked(
     )
     meta = jnp.concatenate([pack.counts[:, None], pack.exp_counts], axis=1)
     a2a = _a2a_select(transport, n_chunks, straggler)
-    recv, recv_meta = a2a(pack.send_x, meta, axis)
+    build = trace_ev.active_build()
+    res = a2a(pack.send_x, meta, axis)
+    recv, recv_meta = res[:2]
     recv_counts = recv_meta[:, 0]
     recv_exp_counts = recv_meta[:, 1:]
     slot_idx = jnp.arange(capacity)[None, :]
     recv_valid = slot_idx < recv_counts[:, None]
     tokens, _ = _decode_payload(recv, h, n, capacity, payload_dtype,
                                 x.dtype)
-    return EPChunkDispatch(
+    disp = EPChunkDispatch(
         x=tokens,
         expert_counts=recv_exp_counts,
         valid=recv_valid,
@@ -463,6 +482,7 @@ def ep_dispatch_chunked(
         send_counts=pack.counts,
         drops=pack.drops,
     )
+    return (disp, res[2]) if build is not None else disp
 
 
 def fit_chunks(n_chunks: int, capacity: int) -> int:
@@ -495,7 +515,8 @@ def ep_expert_ffn_chunked(
     w_gate_up: jax.Array,  # (E_loc, H, 2I)
     w_down: jax.Array,  # (E_loc, I, H)
     n_chunks: int = 1,
-) -> jax.Array:
+    trace_rank=None,
+):
     """Run this rank's experts chunk-by-chunk over the received tokens ->
     (n, C, H) f32 in slot order.
 
@@ -504,10 +525,19 @@ def ep_expert_ffn_chunked(
     no gather, and the output is already in slot order for the combine.
     Each chunk's FFN depends only on that chunk's rows: the compute for
     chunk c is issueable the moment all_to_all_chunked's chunk-c
-    semaphores clear, while chunks c+1.. are still on the wire."""
+    semaphores clear, while chunks c+1.. are still on the wire.
+
+    Under an active trace build returns (y, mark_stream): each chunk's
+    FFN is bracketed by BEGIN/END marks (pure-jnp records, data-chained
+    through the chunk's input/output so they order with the real
+    execution; `trace_rank` tags the stream's header)."""
     n, c, h = disp.x.shape
     if c % n_chunks:
         raise ValueError(f"n_chunks={n_chunks} must divide capacity {c}")
+    build = trace_ev.active_build()
+    marks = (trace_ev.new_stream(build, rank=trace_rank)
+             if build is not None else None)
+    R = trace_ev.REGIONS
     w_gu_e, w_dn_e = _extended_stacks(w_gate_up, w_down)
     rows = c // n_chunks
     ys = []
@@ -515,6 +545,9 @@ def ep_expert_ffn_chunked(
         lo = ci * rows
         gs = chunk_group_sizes(disp.expert_counts, c, lo, rows)
         xc = jax.lax.slice_in_dim(disp.x, lo, lo + rows, axis=1)
+        marks = trace_ev.mark(marks, R["ep.ffn_chunk"],
+                              trace_ev.KIND_BEGIN, payload=ci,
+                              token=xc[0, 0, 0])
         # chunk rows are segment-major and the group-id sequence restarts
         # at every segment boundary, so the FFN loops segments (static,
         # n <= mesh axis) against the ONE (E_loc+1)-block stack rather
@@ -525,10 +558,14 @@ def ep_expert_ffn_chunked(
             act = silu_mul(hh).astype(disp.x.dtype)
             yseg.append(
                 grouped_gemm(act, w_dn_e, gs[j], out_dtype=jnp.float32))
-        ys.append(jnp.stack(yseg, axis=0))  # (n, rows, h)
+        yc = jnp.stack(yseg, axis=0)  # (n, rows, h)
+        marks = trace_ev.mark(marks, R["ep.ffn_chunk"], trace_ev.KIND_END,
+                              payload=ci, token=yc[0, 0, 0])
+        ys.append(yc)
     y = jnp.concatenate(ys, axis=1) if len(ys) > 1 else ys[0]
     # null-group rows ran expert 0's weights; mask them out
-    return jnp.where(disp.valid[..., None], y, 0.0)
+    y = jnp.where(disp.valid[..., None], y, 0.0)
+    return (y, marks) if build is not None else y
 
 
 def ep_combine_chunked(
@@ -544,10 +581,13 @@ def ep_combine_chunked(
     """Chunk-streamed combine: each capacity chunk of the result buffer
     travels back on its own delivery semaphore as it finishes, instead
     of waiting for the full (n, C, H) buffer (the return leg of the
-    reference's double-buffered combine, ep_a2a_layer.py:240)."""
+    reference's double-buffered combine, ep_a2a_layer.py:240).
+
+    Under an active trace build returns (out, trace_buf)."""
     a2a = _a2a_select(transport, n_chunks, straggler)
-    back, _ = a2a(y.astype(jnp.float32), disp.counts, axis)
-    return _combine_scatter(back, disp, m, out_dtype)
+    res = a2a(y.astype(jnp.float32), disp.counts, axis)
+    out = _combine_scatter(res[0], disp, m, out_dtype)
+    return (out, res[2]) if trace_ev.active_build() is not None else out
 
 
 def ep_moe_pipeline(
@@ -566,17 +606,35 @@ def ep_moe_pipeline(
     """The chunk-pipelined EP MoE core: chunked dispatch -> per-chunk
     grouped FFN -> chunk-streamed combine. Returns ((M, H) f32 output,
     drops). Same routing and drops as the sequential
-    ep_dispatch/ep_expert_ffn/ep_combine composition."""
+    ep_dispatch/ep_expert_ffn/ep_combine composition.
+
+    Under an active trace build returns (out, drops, traces): a dict of
+    the three stage streams — the dispatch/combine transports' device
+    buffers plus the per-chunk FFN mark stream — keyed for
+    trace.assemble."""
     n = jax.lax.axis_size(axis)
     n_experts = w_gate_up.shape[0] * n
-    disp = ep_dispatch_chunked(
+    build = trace_ev.active_build()
+    res = ep_dispatch_chunked(
         x, topk_ids, topk_weights, n_experts, capacity, axis,
         n_chunks=n_chunks, payload_dtype=payload_dtype,
         transport=transport, straggler=straggler,
     )
-    y = ep_expert_ffn_chunked(disp, w_gate_up, w_down, n_chunks=n_chunks)
-    out = ep_combine_chunked(
+    disp, disp_tbuf = res if build is not None else (res, None)
+    rank = jax.lax.axis_index(axis) if build is not None else None
+    res = ep_expert_ffn_chunked(disp, w_gate_up, w_down,
+                                n_chunks=n_chunks, trace_rank=rank)
+    y, ffn_marks = res if build is not None else (res, None)
+    res = ep_combine_chunked(
         y, disp, x.shape[0], jnp.float32, axis, n_chunks=n_chunks,
         transport=transport, straggler=straggler,
     )
-    return out, disp.drops
+    out, comb_tbuf = res if build is not None else (res, None)
+    if build is None:
+        return out, disp.drops
+    traces = {
+        "ep.dispatch.a2a": disp_tbuf,
+        "ep.ffn": ffn_marks,
+        "ep.combine.a2a": comb_tbuf,
+    }
+    return out, disp.drops, traces

@@ -51,7 +51,11 @@ def gemm_ar(
             a.dtype
         )
         return one_shot_all_reduce(partial, axis)
-    scattered = gemm_rs(a, b, axis, config=config)
+    from triton_dist_tpu.trace.events import primary
+
+    # primary(): build-safe under trace.building() (buffers dropped; see
+    # tp_mlp.dist_fwd)
+    scattered = primary(gemm_rs(a, b, axis, config=config))
     return ring_all_gather(scattered, axis)
 
 

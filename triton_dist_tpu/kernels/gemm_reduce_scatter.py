@@ -56,6 +56,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.trace import events as trace_ev
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,15 +141,23 @@ def _partial_chunk_streamed(a_ref, b_ref, chunk, m_loc, tn, a_chunk,
 
 
 def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
-             send_sem, recv_sems, credit_sem):
+             send_sem, recv_sems, credit_sem, tctx=None):
     """The shared producer ring: partial_fn(chunk, dst_ref) fills dst with
     this rank's partial of a global chunk; the ring protocol (credit flow
     control, parity recv semaphores) is reduce_scatter._ring_rs_kernel's,
-    with the stage computed instead of loaded."""
+    with the stage computed instead of loaded.
+
+    `tctx` (trace.events.TraceCtx or None) gates the event records:
+    per-hop credit waits and recv waits (sem_wait class) vs per-chunk
+    partial-GEMM spans (compute) — the wait-vs-MXU breakdown of the
+    producer/consumer overlap this kernel exists for."""
     me = jax.lax.axis_index(axis)
+    trace_ev.init_ctx(tctx, rank=me)
+    R = trace_ev.REGIONS
 
     if n == 1:
-        partial_fn(jnp.int32(0), acc.at[0])
+        with trace_ev.span(tctx, R["rs.partial"], payload=0):
+            partial_fn(jnp.int32(0), acc.at[0])
         st = pltpu.make_async_copy(acc.at[0], o_ref, st_sem)
         st.start()
         st.wait()
@@ -157,6 +166,10 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
     shmem.neighbor_barrier(axis, me, n)
+    if straggler[1] > 0:
+        trace_ev.instant(
+            tctx, R["straggle"],
+            payload=jnp.where(me == straggler[0], straggler[1], 0))
     shmem.straggler_delay(axis, *straggler)
     # Step-0 incoming targets our slot 1 (free): grant left one credit
     # (flow-control protocol of reduce_scatter._ring_rs_kernel).
@@ -166,11 +179,13 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
     )
 
     # Compute our partial of the first travelling chunk, (me-1) mod n.
-    partial_fn(jnp.mod(me - 1, n), acc.at[0])
+    with trace_ev.span(tctx, R["rs.partial"], payload=0):
+        partial_fn(jnp.mod(me - 1, n), acc.at[0])
 
     for s in range(n - 1):
         cur, nxt = s % 2, (s + 1) % 2
-        pltpu.semaphore_wait(credit_sem, 1)
+        with trace_ev.span(tctx, R["rs.credit"], payload=s):
+            pltpu.semaphore_wait(credit_sem, 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=acc.at[cur],
             dst_ref=acc.at[nxt],
@@ -182,14 +197,16 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
         rdma.start()
         # MXU fills the stage with our partial of the incoming chunk while
         # the hop is in flight — this is the producer/consumer overlap.
-        partial_fn(jnp.mod(me - s - 2, n), stage)
-        rdma.wait_send()
-        if s + 1 <= n - 2:
-            pltpu.semaphore_signal(
-                credit_sem, inc=1, device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-        rdma.wait_recv()
+        with trace_ev.span(tctx, R["rs.partial"], payload=s + 1):
+            partial_fn(jnp.mod(me - s - 2, n), stage)
+        with trace_ev.span(tctx, R["rs.hop"], payload=s):
+            rdma.wait_send()
+            if s + 1 <= n - 2:
+                pltpu.semaphore_signal(
+                    credit_sem, inc=1, device_id={axis: left},
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+            rdma.wait_recv()
         acc[nxt] = acc[nxt] + stage[...]
 
     final = (n - 1) % 2
@@ -206,10 +223,15 @@ def _src_slot(me, n, chunk, a_arrival):
 
 
 def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
-                    a_arrival: bool,
-                    a_ref, b_ref, o_ref, acc, stage, a_tile,
-                    ld_sems, st_sem, send_sem, recv_sems, credit_sem):
+                    a_arrival: bool, build, *refs):
     """Resident regime: b in VMEM, A in (tm, K_loc) tiles."""
+    refs = list(refs)
+    a_ref, b_ref, o_ref = refs[:3]
+    del refs[:3]
+    tbuf = refs.pop(0) if build is not None else None
+    tcur = refs.pop() if build is not None else None
+    (acc, stage, a_tile, ld_sems, st_sem, send_sem, recv_sems,
+     credit_sem) = refs
     me = jax.lax.axis_index(axis)
     m_loc = o_ref.shape[0]
 
@@ -218,15 +240,20 @@ def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
                        m_loc, tm, a_tile, dst, ld_sems, out_dtype)
 
     _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
-             send_sem, recv_sems, credit_sem)
+             send_sem, recv_sems, credit_sem,
+             tctx=trace_ev.make_ctx(build, tbuf, tcur))
 
 
 def _gemm_rs_kernel_streamed(axis: str, n: int, tn: int, out_dtype,
-                             straggler, a_arrival: bool,
-                             a_ref, b_ref, o_ref, acc, stage, a_chunk,
-                             b_tile, a_sem, b_sems, st_sem, send_sem,
-                             recv_sems, credit_sem):
+                             straggler, a_arrival: bool, build, *refs):
     """Streamed regime: A chunk in VMEM, b in (K_loc, tn) column tiles."""
+    refs = list(refs)
+    a_ref, b_ref, o_ref = refs[:3]
+    del refs[:3]
+    tbuf = refs.pop(0) if build is not None else None
+    tcur = refs.pop() if build is not None else None
+    (acc, stage, a_chunk, b_tile, a_sem, b_sems, st_sem, send_sem,
+     recv_sems, credit_sem) = refs
     me = jax.lax.axis_index(axis)
     m_loc = o_ref.shape[0]
 
@@ -237,7 +264,8 @@ def _gemm_rs_kernel_streamed(axis: str, n: int, tn: int, out_dtype,
         )
 
     _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
-             send_sem, recv_sems, credit_sem)
+             send_sem, recv_sems, credit_sem,
+             tctx=trace_ev.make_ctx(build, tbuf, tcur))
 
 
 def _local_mm_kernel(nk: int, out_dtype, a_ref, b_ref, o_ref, acc=None):
@@ -302,12 +330,21 @@ def gemm_rs(
     a_order="arrival" consumes A whose row blocks are in ag_gemm's
     ring-arrival order (see ag_gemm c_order) by remapping the chunk
     index — free in the kernel, a block un-permute on fallback paths.
+
+    Tracing (trace.building active): one extra trailing output — the
+    ring regimes' device trace buffer (credit/hop waits vs partial-GEMM
+    spans); local_mm/xla paths return an empty buffer.
     """
     global _last_regime
     cfg = config or GemmRsConfig()
     out_dtype = out_dtype or a.dtype
     assert a_order in ("rank", "arrival"), a_order
     a_arrival = a_order == "arrival"
+    build = trace_ev.active_build()
+
+    def with_trace(res, tbuf=None):
+        return trace_ev.with_trace(build, res, tbuf)
+
     n = jax.lax.axis_size(axis)
     m, k_loc = a.shape
     k2, n_full = b.shape
@@ -315,9 +352,10 @@ def gemm_rs(
     if n == 1 and not force_kernel:
         # Nothing to scatter at world=1; XLA's matmul wins (see ag_gemm).
         _last_regime = "xla"
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
-            out_dtype
-        )
+        return with_trace(
+            jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+                out_dtype
+            ))
     if m % n:
         raise ValueError(f"M={m} not divisible by axis size {n}")
     m_loc = m // n
@@ -360,7 +398,7 @@ def gemm_rs(
 
     if interpret_no_headroom() and not force_kernel:
         _last_regime = "xla"
-        return xla_path()
+        return with_trace(xla_path())
 
     cost = cost_estimate(
         flops=2 * m * k_loc * n_full,
@@ -370,19 +408,34 @@ def gemm_rs(
     )
     cid = next_collective_id(f"gemm_rs_{axis}") if n > 1 else None
 
+    def _ring_call(kernel, out_shape, in_specs, out_specs, scratch,
+                   params, cost_est):
+        if build is not None:
+            out_shape = (out_shape, trace_ev.out_shape(build))
+            out_specs = (out_specs, trace_ev.out_spec())
+            scratch = scratch + [trace_ev.cursor_scratch()]
+        res = tpu_call(
+            kernel, out_shape=out_shape, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch,
+            compiler_params=params, cost_estimate=cost_est,
+        )(a, b)
+        if build is not None:
+            return with_trace(res[0], res[1])
+        return res
+
     if vmem_resident <= cfg.vmem_budget:
         _last_regime = "resident"
-        return tpu_call(
+        return _ring_call(
             functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype,
                               (cfg.straggler_rank, cfg.straggler_ns),
-                              a_arrival),
-            out_shape=jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
-            in_specs=[
+                              a_arrival, build),
+            jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
+            [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            [
                 pltpu.VMEM((2, m_loc, n_full), out_dtype),
                 pltpu.VMEM((m_loc, n_full), out_dtype),
                 pltpu.VMEM((2, tm, k_loc), a.dtype),
@@ -392,7 +445,7 @@ def gemm_rs(
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR,
             ],
-            compiler_params=compiler_params(
+            compiler_params(
                 has_side_effects=True,
                 # barrier semaphore only exists in the n>1 kernel body (see
                 # neighbor_barrier); collective_id must be omitted at n=1.
@@ -400,8 +453,8 @@ def gemm_rs(
                 vmem_limit_bytes=cfg.vmem_budget + (2 << 20),
             ),
             # launch_metadata analog (ref allgather_gemm.py:145-155)
-            cost_estimate=cost,
-        )(a, b)
+            cost,
+        )
 
     # Streamed regime: pick the widest b column tile that fits.
     tn_cands = _col_tile_candidates(n_full, cfg.tile_n)
@@ -411,17 +464,18 @@ def gemm_rs(
         tn = tn_cands[-1]  # forced: smallest tile, budget overridden below
     if n > 1 and tn is not None:
         _last_regime = "streamed"
-        return tpu_call(
+        return _ring_call(
             functools.partial(
                 _gemm_rs_kernel_streamed, axis, n, tn, out_dtype,
-                (cfg.straggler_rank, cfg.straggler_ns), a_arrival),
-            out_shape=jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
-            in_specs=[
+                (cfg.straggler_rank, cfg.straggler_ns), a_arrival,
+                build),
+            jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
+            [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            [
                 pltpu.VMEM((2, m_loc, n_full), out_dtype),
                 pltpu.VMEM((m_loc, n_full), out_dtype),
                 pltpu.VMEM((m_loc, k_loc), a.dtype),
@@ -433,20 +487,20 @@ def gemm_rs(
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR,
             ],
-            compiler_params=compiler_params(
+            compiler_params(
                 has_side_effects=True,
                 collective_id=cid,
                 vmem_limit_bytes=max(cfg.vmem_budget,
                                      vmem_streamed(tn)) + (2 << 20),
             ),
-            cost_estimate=cost_estimate(
+            cost_estimate(
                 flops=2 * m * k_loc * n_full,
                 # b re-streams once per chunk in this regime
                 bytes_accessed=(m * k_loc + n * k_loc * n_full)
                 * in_itemsize + m_loc * n_full * out_itemsize,
                 remote_bytes=(n - 1) * m_loc * n_full * out_itemsize,
             ),
-        )(a, b)
+        )
 
     if n == 1:
         # force_kernel at world=1 past the resident budget: blocked matmul.
@@ -461,7 +515,7 @@ def gemm_rs(
         vmem_local = 2 * (tm_l * tk_l + tk_l * tn_l) * in_itemsize \
             + 2 * tm_l * tn_l * out_itemsize \
             + (tm_l * tn_l * 4 if nk > 1 else 0)
-        return tpu_call(
+        return with_trace(tpu_call(
             functools.partial(_local_mm_kernel, nk, out_dtype),
             grid=(m // tm_l, n_full // tn_l, nk),
             out_shape=jax.ShapeDtypeStruct((m, n_full), out_dtype),
@@ -482,10 +536,10 @@ def gemm_rs(
                 + (2 << 20),
             ),
             cost_estimate=cost,
-        )(a, b)
+        )(a, b))
 
     _last_regime = "xla"
-    return xla_path()
+    return with_trace(xla_path())
 
 
 def gemm_rs_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:

@@ -73,3 +73,26 @@ def notify(sem, pe, signal_val=1, sig_op=SIGNAL_ADD, comm_scope="intra_node", ax
     """Set/add a signal on `pe` (ref: DistributedOps.td:151 `notify`)."""
     del comm_scope
     shmem.signal(sem, signal_val, sig_op, pe, axis)
+
+
+# -- in-kernel trace primitives (triton_dist_tpu.trace.events) ---------------
+# Lazy-imported so `lang` never pulls the trace package at import time
+# (trace.collect is host-side machinery kernels don't need). Both are
+# trace-time no-ops when `ctx` is None — i.e. whenever the kernel was
+# built without `trace.building()` — so uninstrumented builds compile
+# bit-identical programs.
+
+
+def trace_span(ctx, region, payload=0, aux=0):
+    """BEGIN/END span context manager around kernel-body code (the
+    device-side analog of the reference's intra-kernel profiler slots)."""
+    from triton_dist_tpu.trace.events import span as _span
+
+    return _span(ctx, region, payload, aux)
+
+
+def trace_instant(ctx, region, payload=0, aux=0):
+    """One point event (prefetch hit/miss, send issued, ...)."""
+    from triton_dist_tpu.trace.events import instant as _instant
+
+    return _instant(ctx, region, payload, aux)

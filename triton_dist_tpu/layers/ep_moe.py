@@ -63,7 +63,12 @@ def ep_moe_fwd(
     (perf_model.choose_ep_chunks); the count is fitted down to a divisor
     of `capacity`. `_transport` selects the pipeline's transport arm
     ('chunked' | 'plain' | 'ref') — test hook for the bit-identity
-    oracle, not a user knob."""
+    oracle, not a user knob.
+
+    Tracing (trace.building active): the OVERLAP path returns one extra
+    trailing output — the pipeline's {stream: buffer} trace dict (see
+    ep_moe_pipeline / docs/observability.md); the sequential path is
+    untraced and unchanged."""
     n = jax.lax.axis_size(axis)
     e_loc = params.w_gate_up.shape[0]
     n_experts = e_loc * n
@@ -84,11 +89,19 @@ def ep_moe_fwd(
                 dtype=x.dtype, payload_dtype=payload_dtype,
             )
         q = fit_chunks(n_chunks, capacity)
-        out, drops = ep_moe_pipeline(
+        res = ep_moe_pipeline(
             x, ids, weights, params.w_gate_up, params.w_down, capacity,
             axis, n_chunks=q, payload_dtype=payload_dtype,
             transport=_transport,
         )
+        from triton_dist_tpu.trace.events import active_build
+
+        if active_build() is not None:
+            out, drops, traces = res
+            out = out.astype(x.dtype)
+            ret = (out, drops) if return_drops else (out,)
+            return ret + (traces,)
+        out, drops = res
         out = out.astype(x.dtype)
         return (out, drops) if return_drops else out
     disp = ep_dispatch(x, ids, weights, n_experts, capacity, axis,

@@ -134,10 +134,15 @@ def tp_attn_dist_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
     """Fused path (ref dist_triton_fwd, tp_attn.py:215): overlapped
     AG+GEMM QKV projection, attention, overlapped GEMM+RS O projection.
     x_shard: (M/n, hidden) -> ((M/n, hidden), new_kv_cache)."""
-    qkv = ag_gemm(x_shard, params.w_qkv, axis=axis, config=ag_config)
+    from triton_dist_tpu.trace.events import primary
+
+    # primary(): build-safe under trace.building() (buffers dropped; see
+    # tp_mlp.dist_fwd)
+    qkv = primary(ag_gemm(x_shard, params.w_qkv, axis=axis,
+                          config=ag_config))
     out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
                                 positions, kv_cache, kv_len)
-    y = gemm_rs(out, params.w_o, axis=axis, config=rs_config)
+    y = primary(gemm_rs(out, params.w_o, axis=axis, config=rs_config))
     return y, new_cache
 
 

@@ -84,14 +84,19 @@ def tp_mlp_dist_fwd(
     AG+GEMM with the silu(gate)*up epilogue fused into the kernel store
     (the f32 intermediate never reaches HBM), then GEMM+RS.
     x_shard: (M/n, hidden) -> (M/n, hidden)."""
-    act = ag_gemm(
+    from triton_dist_tpu.trace.events import primary
+
+    # primary(): strip the trailing trace buffer when built under
+    # trace.building() — this composite does not thread per-kernel
+    # buffers outward (yet), but must stay build-safe
+    act = primary(ag_gemm(
         x_shard, (params.w_gate, params.w_up), axis=axis, config=ag_config,
         epilogue="silu_pair", c_order="arrival",
-    )
+    ))
     # arrival-order act: gemm_rs remaps chunk indices for free (the
     # row-block permutation never materializes)
-    return gemm_rs(act, params.w_down, axis=axis, config=rs_config,
-                   a_order="arrival")
+    return primary(gemm_rs(act, params.w_down, axis=axis,
+                           config=rs_config, a_order="arrival"))
 
 
 def tp_mlp_ar_fwd(

@@ -76,6 +76,7 @@ from triton_dist_tpu.mega.scheduler import (
     plan_prefetch,
     plan_store_forward,
 )
+from triton_dist_tpu.trace import events as trace_ev
 
 # Queue row layout (all static, built at compile time):
 #   [branch, a0..a5,
@@ -165,6 +166,7 @@ class _Env:
     kvsems: Any = None
     send: Any = None
     recv: Any = None
+    tctx: Any = None  # trace.events.TraceCtx (None = tracing off)
 
     def ws_rows(self, slot, width):
         return self.ws.at[pl.ds(slot * self.pb, self.pb), pl.ds(0, width)]
@@ -295,6 +297,11 @@ def _matmul_branch(key, env: _Env):
             cp_in.start()
 
         if pf_eligible:
+            # prefetch-arena consume: payload > 0 = hit (arena slot
+            # pf_in - 1 was streamed by an earlier row), 0 = cold miss
+            trace_ev.instant(env.tctx, trace_ev.REGIONS["mega.pf"],
+                             payload=pf_in)
+
             @pl.when(pf_in == 0)
             def _cold_first_tile():
                 wcopy(layer, 0, 0).start()
@@ -946,7 +953,16 @@ def compile_graph(
     """Lower (graph, schedule) to one pallas_call (the reference's
     ModelBuilder.compile, model_builder.py:372-389: codegen + jit). The
     queue array is built once; the returned `run` is pure and jittable
-    (call it inside shard_map for world>1 graphs)."""
+    (call it inside shard_map for world>1 graphs).
+
+    Tracing: when compile_graph runs under trace.building(), the kernel
+    carries a per-core record buffer — task spans (payload=branch,
+    aux=queue row), scoreboard-wait spans, prefetch hit/miss instants —
+    and `run` returns (ws, trace_buf); trace/attribution.
+    compare_predicted diffs the result against scheduler.predicted_stalls
+    queue by queue. Default builds are bit-identical (the flag is read
+    ONCE here, at graph-compile time)."""
+    build = trace_ev.active_build()
     B = graph.batch
     PB = round_up(B, min_tile(dtype)[0])
     tasks = graph.tasks
@@ -1113,20 +1129,33 @@ def compile_graph(
         + (4 << 20)
     )
 
+    # world/axis for the trace header rank (the AR/barrier branch keys
+    # carry the mesh axis when the graph is distributed)
+    trace_axis = next((k[2] for k in ar_keys
+                       if k[0] == "allreduce_add" and k[3] > 1),
+                      None) or next((k[1] for k in ar_keys
+                                     if k[0] == "barrier" and k[2] > 1),
+                                    None)
+
     def kernel(q_ref, pos_ref, tbl_ref, ws_in, *rest):
         nw = len(weight_names)
         w_refs = rest[:nw]
-        tail = rest[nw:]
+        tail = list(rest[nw:])
+        tcur = tail.pop() if build is not None else None
         if nc > 1:
-            sb = tail[-1]
-            tail = tail[:-1]
-        (norms, rope_cs, k_cache, v_cache,
-         ws_out,
-         vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
+            sb = tail.pop()
+        (norms, rope_cs, k_cache, v_cache, ws_out) = tail[:5]
+        tail = tail[5:]
+        tbuf = tail.pop(0) if build is not None else None
+        (vin, vin2, vout, vw, vkv, vrope, vnq, vnk, vpf, mailbox,
          ld1, ld2, st, wsems, kvsem, kvsems, send, recv, pfsem,
          chsem) = tail
         del ws_in  # aliased: access via the output ref
+        tctx = trace_ev.make_ctx(
+            build, tbuf, tcur,
+            lane=pl.program_id(0) if nc > 1 else 0)
         env = _Env(
+            tctx=tctx,
             dtype=dtype, batch=B, pb=PB, wmax=wmax, pos=pos_ref,
             table=tbl_ref, straggler=straggler,
             ws=ws_out, weights=dict(zip(weight_names, w_refs)),
@@ -1154,6 +1183,16 @@ def compile_graph(
 
         a = [row(j) for j in range(1, ROW)]
 
+        # trace init: each core's first queue row, before any emit
+        if build is not None:
+            @pl.when(ti == 0)
+            def _trace_init():
+                trace_ev.init_ctx(
+                    tctx,
+                    rank=(jax.lax.axis_index(trace_axis)
+                          if trace_axis is not None else 0),
+                    lane_id=pl.program_id(0) if nc > 1 else 0)
+
         if nc > 1:
             # scoreboard waits: consume the planned delta of completions
             # of each other queue from the LOCAL semaphore instance
@@ -1162,7 +1201,10 @@ def compile_graph(
 
                 @pl.when(delta > 0)
                 def _(c2=c2, delta=delta):
-                    pltpu.semaphore_wait(sb.at[c2], delta)
+                    with trace_ev.span(tctx,
+                                       trace_ev.REGIONS["mega.sb_wait"],
+                                       payload=c2, aux=ti):
+                        pltpu.semaphore_wait(sb.at[c2], delta)
 
         def dispatch(f):
             # pend_early=1: the previous row's deferred store must land
@@ -1176,7 +1218,28 @@ def compile_graph(
             if not getattr(f, "handles_prefetch", False):
                 _maybe_prefetch(env, a[6], a[7], a[8])
 
+        # task span: payload = branch id, aux = queue position. Padding
+        # and drain rows (the noop branch) are excluded so a queue's
+        # traced span count equals its scheduled length
+        # (attribution.compare_predicted's coverage check).
+        if build is not None:
+            noop_b = branch_of.get(("noop",))
+            is_task = jnp.asarray(True) if noop_b is None \
+                else (row(0) != noop_b)
+
+            @pl.when(is_task)
+            def _task_begin():
+                trace_ev.emit(tctx, trace_ev.REGIONS["mega.task"],
+                              trace_ev.KIND_BEGIN, payload=row(0),
+                              aux=ti)
+
         jax.lax.switch(row(0), [lambda f=f: dispatch(f) for f in bodies])
+
+        if build is not None:
+            @pl.when(is_task)
+            def _task_end():
+                trace_ev.emit(tctx, trace_ev.REGIONS["mega.task"],
+                              trace_ev.KIND_END, payload=row(0), aux=ti)
 
         if nc > 1:
             sig = row(ROW + nc)
@@ -1202,7 +1265,8 @@ def compile_graph(
             grid=grid,
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             + [any_spec] * (1 + nw + 4),
-            out_specs=any_spec,
+            out_specs=((any_spec, trace_ev.out_spec())
+                       if build is not None else any_spec),
             scratch_shapes=[
                 pltpu.VMEM((PB, wmax), dtype),           # vin
                 pltpu.VMEM((max(PB, 2), wmax), dtype),   # vin2 (rows 0/1:
@@ -1234,6 +1298,8 @@ def compile_graph(
             ] + (
                 # multi-core scoreboard: sb[c] counts queue c completions
                 [pltpu.SemaphoreType.REGULAR((nc,))] if nc > 1 else []
+            ) + (
+                [trace_ev.cursor_scratch()] if build is not None else []
             ),
         )
         extra: Dict[str, Any] = {}
@@ -1261,10 +1327,13 @@ def compile_graph(
                         f"num_cores={phys} (multi-core needs v4/v5p-class "
                         "megacore chips)"
                     )
+        out_shape = (jax.ShapeDtypeStruct(ws.shape, ws.dtype),) + (
+            (trace_ev.out_shape(build, lanes=nc),)
+            if build is not None else ())
         fn = tpu_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+            out_shape=out_shape if build is not None else out_shape[0],
             # inputs: queue(0) pos(1) table(2) ws(3) weights(4..) ...
             input_output_aliases={3: 0},
             compiler_params=compiler_params(
@@ -1282,6 +1351,7 @@ def compile_graph(
         w_list = [weights[n] for n in weight_names]
         return fn(jnp.asarray(queue), pos, jnp.asarray(table, jnp.int32),
                   ws, *w_list, norms, rope_cs, k, v)
+        # (traced builds: fn returns (ws, trace_buf) — see docstring)
 
     return CompiledMega(
         run=run, queue=queue, n_slots=n_slots, pb=PB, wmax=wmax,

@@ -276,6 +276,11 @@ class MegaQwen3:
         sched = schedule_graph(self.graph, num_cores=num_cores)
         validate_schedule(self.graph, sched)
         self.sched = sched
+        # construct the model inside trace.building() for a traced
+        # megakernel: decode_step then returns (logits, cache, trace_buf)
+        from triton_dist_tpu.trace.events import active_build
+
+        self._trace_build = active_build()
         self.cm: CompiledMega = compile_graph(
             self.graph, sched, dt, name=f"mega_qwen3_{axis}{n}",
             straggler=straggler,
@@ -328,11 +333,14 @@ class MegaQwen3:
                  cache: MegaKVCache):
             return self._device_step(params, w_gate_up, tokens, cache)
 
+        out_specs = (P(), c_specs)
+        if self._trace_build is not None:
+            out_specs += (P(axis),)  # per-rank trace buffers, stacked
         self._decode = jax.jit(
             jax.shard_map(
                 step, mesh=mesh,
                 in_specs=(p_specs, P(None, axis), P(), c_specs),
-                out_specs=(P(), c_specs),
+                out_specs=out_specs,
                 check_vma=False,
             ),
             donate_argnums=(3,) if donate_cache else (),
@@ -397,8 +405,12 @@ class MegaQwen3:
                                      cfg.head_dim)
             table = self._ident_table
 
-        ws_o = self.cm.run(pos, table, ws, weights, norms,
-                           self._rope_cs, k_pool, v_pool)
+        res = self.cm.run(pos, table, ws, weights, norms,
+                          self._rope_cs, k_pool, v_pool)
+        if self._trace_build is not None:
+            ws_o, trace_buf = res
+        else:
+            ws_o, trace_buf = res, None
 
         hidden = jax.lax.dynamic_slice(
             ws_o, (self._final_rows, 0), (pb, self.cm.wmax)
@@ -422,6 +434,12 @@ class MegaQwen3:
         kn = jnp.moveaxis(kn, 2, 1)  # (L, Hkv, B, D)
         vn = jnp.moveaxis(vn, 2, 1)
         bidx = jnp.arange(B)
+
+        def ret(logits, new_cache):
+            if trace_buf is not None:
+                return logits, new_cache, trace_buf
+            return logits, new_cache
+
         if isinstance(cache, PagedMegaKVCache):
             # page allocation (bump allocator): a sequence crossing into
             # a fresh page claims the next pool page(s) this step
@@ -437,11 +455,12 @@ class MegaQwen3:
             offs = cache.length % self.page
             k = cache.k.at[:, :, slots, offs].set(kn.astype(dt))
             v = cache.v.at[:, :, slots, offs].set(vn.astype(dt))
-            return logits, PagedMegaKVCache(k, v, table,
-                                            cache.length + 1, next_free)
+            return ret(logits, PagedMegaKVCache(k, v, table,
+                                                cache.length + 1,
+                                                next_free))
         k = cache.k.at[:, :, bidx, cache.length].set(kn.astype(dt))
         v = cache.v.at[:, :, bidx, cache.length].set(vn.astype(dt))
-        return logits, MegaKVCache(k, v, cache.length + 1)
+        return ret(logits, MegaKVCache(k, v, cache.length + 1))
 
     # -- public API ----------------------------------------------------------
 

@@ -7,8 +7,6 @@ TPU-native analogs of the reference host utilities
 
 from __future__ import annotations
 
-import contextlib
-import os
 import time
 from typing import Callable, Tuple
 
@@ -234,51 +232,10 @@ def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose=True):
     raise AssertionError("\n".join(msg))
 
 
-@contextlib.contextmanager
-def group_profile(name: str = "profile", do_prof: bool = True, out_dir: str = None):
-    """Profiling context writing an xplane trace per process.
-
-    The reference merges per-rank chrome traces into one
-    (ref: utils.py:505-589); on TPU jax.profiler writes a unified xplane
-    trace per host that already carries all local device lanes; TensorBoard
-    merges multi-host by directory.
-    """
-    if not do_prof:
-        yield
-        return
-    out_dir = out_dir or os.environ.get("TDT_PROFILE_DIR", "/tmp/tdt_profile")
-    path = os.path.join(out_dir, f"{name}")
-    jax.profiler.start_trace(path)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-        dist_print(f"profile written to {path}")
-
-
-def merge_traces(per_process_dirs, out_dir: str) -> str:
-    """Collect per-process trace directories into one TensorBoard logdir
-    (the reference's multi-rank trace merge, ref utils.py:370-502: chrome
-    traces gathered to rank 0 with pid/tid remapping). The xplane format
-    needs no event rewriting — TensorBoard renders every host found under
-    one logdir — so the merge is a process-tagged relocation of each
-    host's `plugins/profile` runs."""
-    import shutil
-
-    os.makedirs(out_dir, exist_ok=True)
-    merged = []
-    for pid, src in enumerate(per_process_dirs):
-        prof_root = os.path.join(src, "plugins", "profile")
-        if not os.path.isdir(prof_root):
-            continue
-        for run in sorted(os.listdir(prof_root)):
-            dst = os.path.join(out_dir, "plugins", "profile",
-                               f"{run}_p{pid}")
-            shutil.copytree(os.path.join(prof_root, run), dst,
-                            dirs_exist_ok=True)
-            merged.append(dst)
-    if not merged:
-        raise FileNotFoundError(
-            f"no plugins/profile runs found under {list(per_process_dirs)}"
-        )
-    return out_dir
+# group_profile / merge_traces moved to triton_dist_tpu.trace.export —
+# ONE trace-merging code path beside the in-kernel trace exporter. These
+# aliases keep the historical `runtime.utils` import surface working.
+from triton_dist_tpu.trace.export import (  # noqa: E402,F401
+    group_profile,
+    merge_traces,
+)

@@ -1,0 +1,71 @@
+"""triton_dist_tpu.trace — in-kernel event tracing, stall attribution,
+and Perfetto export for the overlapping kernels.
+
+The predicted-vs-measured loop: `perf_model` and `mega.scheduler`
+PREDICT overlap quality (per-queue scoreboard stalls, per-chunk A2A/FFN
+exposure); this subsystem MEASURES it — per-core ring buffers of i32
+(region, kind, seq, payload) records written inside the kernels,
+assembled into a timeline, classified into compute / sem_wait /
+dma_wait / idle, exported as Perfetto-loadable JSON, and diffed against
+`scheduler.predicted_stalls` queue by queue.
+
+Quick start (docs/observability.md has the full story):
+
+    from triton_dist_tpu import trace
+
+    with trace.tracing("ep_moe") as (build, session):
+        # instrumented entry points now return one extra trailing
+        # trace-buffer output
+        out, bufs = jitted_overlapped_moe(x)
+    tl = session.assemble({k: np.asarray(v) for k, v in bufs.items()})
+    print(trace.format_table(tl))
+    trace.write_trace(tl, "/tmp/ep_moe.trace.json")
+
+Tracing is strictly opt-in: with no active `building()` block, the
+instrumented kernels trace byte-identical programs with unchanged
+`pallas_call_count()` (tests/test_trace.py enforces both).
+"""
+
+from triton_dist_tpu.trace.events import (  # noqa: F401
+    KIND_BEGIN,
+    KIND_END,
+    KIND_INSTANT,
+    RECORD_WORDS,
+    REGIONS,
+    TraceBuild,
+    TraceCtx,
+    active_build,
+    building,
+    instant,
+    mark,
+    new_stream,
+    primary,
+    region_id,
+    region_name,
+    span,
+    with_trace,
+)
+from triton_dist_tpu.trace.collect import (  # noqa: F401
+    Event,
+    MalformedTrace,
+    Span,
+    Timeline,
+    TraceSession,
+    assemble,
+    tracing,
+)
+from triton_dist_tpu.trace.attribution import (  # noqa: F401
+    a2a_step_waits,
+    classify,
+    compare_predicted,
+    format_table,
+    per_region,
+    prefetch_hit_rate,
+)
+from triton_dist_tpu.trace.export import (  # noqa: F401
+    group_profile,
+    load_trace_json,
+    merge_traces,
+    to_chrome_trace,
+    write_trace,
+)

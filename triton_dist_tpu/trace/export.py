@@ -1,0 +1,218 @@
+"""Trace export: Chrome-trace/Perfetto JSON + the host profiling
+helpers (the one trace-merging code path).
+
+`to_chrome_trace` emits the Trace Event Format
+(docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+that both chrome://tracing and ui.perfetto.dev load: one process per
+stream, one thread per (rank, lane), "X" complete events for spans and
+"i" instants, with process/thread name metadata. Device streams tick on
+the deterministic seq clock (1 tick = 1 us in the export); each stream
+is offset to its host anchor when the session recorded one, so device
+lanes line up with the python-level host spans (the documented
+wall-time reconstruction for clocks with no hardware stamp).
+
+`group_profile` / `merge_traces` moved here from `runtime.utils` (which
+keeps back-compat aliases): xplane profiling and trace merging now live
+beside the in-kernel trace exporter — one module owns every trace
+artifact this framework writes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Dict, Optional
+
+from triton_dist_tpu.trace import events as ev
+from triton_dist_tpu.trace.collect import MalformedTrace, Timeline
+
+_TICK_US = 1.0  # one seq tick rendered as 1 us
+
+
+def _span_name(region: int, payload: int, aux: int) -> str:
+    name = ev.region_name(region)
+    if name in ("a2a.wait", "a2a.send"):
+        return f"{name} s{payload}c{aux}"
+    if name in ("ag.ring_wait", "rs.credit", "rs.hop", "rs.partial",
+                "ep.ffn_chunk", "mega.sb_wait"):
+        return f"{name} {payload}"
+    if name == "mega.task":
+        return f"{name} b{payload}@{aux}"
+    return name
+
+
+def to_chrome_trace(tl: Timeline) -> dict:
+    """Timeline -> Chrome-trace dict (json.dump-able)."""
+    streams = tl.streams()
+    pid_of = {s: i + 1 for i, s in enumerate(streams)}
+    host_pid = len(streams) + 1
+    events = []
+    # host anchoring: a device stream whose name matches a host span
+    # starts at that span; all other streams start at the session's
+    # FIRST host span (a multi-stream trace like the EP pipeline shares
+    # one "ep_moe"-style span) — so device lanes always line up with the
+    # host process when the session recorded any span at all
+    t_host0 = min((t0 for _, t0, _ in tl.host_spans), default=0)
+    first_off = ((tl.host_spans[0][1] - t_host0) / 1e3
+                 if tl.host_spans else 0.0)
+    offs = {s: first_off for s in streams}
+    for name, t0, _t1 in tl.host_spans:
+        if name in offs:
+            offs[name] = (t0 - t_host0) / 1e3  # ns -> us
+
+    def tid_of(rank: int, lane: int) -> int:
+        return (max(rank, 0)) * 16 + lane + 1
+
+    for s in streams:
+        events.append({"ph": "M", "pid": pid_of[s],
+                       "name": "process_name", "args": {"name": s}})
+    seen_threads = set()
+    for e in tl.events:
+        key = (e.stream, e.rank, e.lane)
+        if key not in seen_threads:
+            seen_threads.add(key)
+            events.append({
+                "ph": "M", "pid": pid_of[e.stream],
+                "tid": tid_of(e.rank, e.lane), "name": "thread_name",
+                "args": {"name": f"rank{e.rank}/core{e.lane}"},
+            })
+    for sp in tl.spans:
+        events.append({
+            "ph": "X", "pid": pid_of[sp.stream],
+            "tid": tid_of(sp.rank, sp.lane),
+            "name": _span_name(sp.region, sp.payload, sp.aux),
+            "cat": ev.REGION_CLASS.get(ev.region_name(sp.region),
+                                       "trace"),
+            "ts": offs[sp.stream] + sp.t0 * _TICK_US,
+            "dur": max(sp.dur, 0.001) * _TICK_US,
+            "args": {"payload": sp.payload, "aux": sp.aux,
+                     "seq_ticks": sp.dur},
+        })
+    for e in tl.events:
+        if e.kind != ev.KIND_INSTANT:
+            continue
+        events.append({
+            "ph": "i", "s": "t", "pid": pid_of[e.stream],
+            "tid": tid_of(e.rank, e.lane),
+            "name": _span_name(e.region, e.payload, e.aux),
+            "ts": offs[e.stream] + e.t * _TICK_US,
+            "args": {"payload": e.payload, "aux": e.aux},
+        })
+    for name, t0, t1 in tl.host_spans:
+        events.append({
+            "ph": "X", "pid": host_pid, "tid": 1, "name": name,
+            "cat": "host",
+            "ts": (t0 - t_host0) / 1e3, "dur": (t1 - t0) / 1e3,
+        })
+    if tl.host_spans:
+        events.append({"ph": "M", "pid": host_pid, "name": "process_name",
+                       "args": {"name": "host"}})
+        events.append({"ph": "M", "pid": host_pid, "tid": 1,
+                       "name": "thread_name", "args": {"name": "python"}})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "label": tl.label,
+            "clock": "seq" if not tl.host_spans else "seq+host-anchored",
+            "drops": {f"{k[0]}/r{k[1]}/c{k[2]}": v
+                      for k, v in tl.drops.items()},
+            "format": "triton_dist_tpu.trace v1",
+        },
+    }
+
+
+def write_trace(tl: Timeline, path: str, extra: Optional[dict] = None
+                ) -> str:
+    """Write the Perfetto JSON; `extra` merges into otherData (e.g. the
+    attribution.compare_predicted report, which scripts/trace_report.py
+    prints back as the predicted-stall diff)."""
+    d = to_chrome_trace(tl)
+    if extra:
+        d["otherData"].update(extra)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(d, f)
+    return path
+
+
+def load_trace_json(path: str) -> dict:
+    """Load + validate an exported trace (scripts/trace_report.py's
+    strict entry: malformed input raises MalformedTrace)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise MalformedTrace(f"{path}: {e}") from e
+    if not isinstance(d, dict) or "traceEvents" not in d:
+        raise MalformedTrace(f"{path}: no traceEvents key")
+    fmt = d.get("otherData", {}).get("format", "")
+    if not str(fmt).startswith("triton_dist_tpu.trace"):
+        raise MalformedTrace(
+            f"{path}: not a triton_dist_tpu trace (format={fmt!r})")
+    for i, e in enumerate(d["traceEvents"]):
+        if not isinstance(e, dict) or "ph" not in e or "pid" not in e:
+            raise MalformedTrace(f"{path}: traceEvents[{i}] malformed")
+        if e["ph"] in ("X", "i") and "ts" not in e:
+            raise MalformedTrace(f"{path}: traceEvents[{i}] missing ts")
+    return d
+
+
+# -- host profiling (moved from runtime.utils — aliases remain there) --------
+
+
+@contextlib.contextmanager
+def group_profile(name: str = "profile", do_prof: bool = True,
+                  out_dir: Optional[str] = None):
+    """Profiling context writing an xplane trace per process.
+
+    The reference merges per-rank chrome traces into one
+    (ref: utils.py:505-589); on TPU jax.profiler writes a unified xplane
+    trace per host that already carries all local device lanes;
+    TensorBoard merges multi-host by directory.
+    """
+    import jax
+
+    if not do_prof:
+        yield
+        return
+    out_dir = out_dir or os.environ.get("TDT_PROFILE_DIR",
+                                        "/tmp/tdt_profile")
+    path = os.path.join(out_dir, f"{name}")
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        from triton_dist_tpu.runtime.utils import dist_print
+
+        dist_print(f"profile written to {path}")
+
+
+def merge_traces(per_process_dirs, out_dir: str) -> str:
+    """Collect per-process trace directories into one TensorBoard logdir
+    (the reference's multi-rank trace merge, ref utils.py:370-502: chrome
+    traces gathered to rank 0 with pid/tid remapping). The xplane format
+    needs no event rewriting — TensorBoard renders every host found under
+    one logdir — so the merge is a process-tagged relocation of each
+    host's `plugins/profile` runs."""
+    import shutil
+
+    os.makedirs(out_dir, exist_ok=True)
+    merged = []
+    for pid, src in enumerate(per_process_dirs):
+        prof_root = os.path.join(src, "plugins", "profile")
+        if not os.path.isdir(prof_root):
+            continue
+        for run in sorted(os.listdir(prof_root)):
+            dst = os.path.join(out_dir, "plugins", "profile",
+                               f"{run}_p{pid}")
+            shutil.copytree(os.path.join(prof_root, run), dst,
+                            dirs_exist_ok=True)
+            merged.append(dst)
+    if not merged:
+        raise FileNotFoundError(
+            f"no plugins/profile runs found under {list(per_process_dirs)}"
+        )
+    return out_dir
