@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Dependency-free pyflakes-subset linter (the `ruff check` fallback).
+
+The tier-1 lint gate (tests/test_verify.py::test_lint_clean) shells
+`ruff check` when ruff is installed and THIS script otherwise, so the
+suite enforces the same hygiene in hermetic containers that bake no
+lint toolchain. Implemented checks — the unused-import slice of
+pyflakes, matching the `[tool.ruff.lint]` config in pyproject.toml:
+
+  F401  imported name never used in the module
+
+Semantics mirror ruff's: `import a.b` binds `a`; `import a.b as c`
+binds `c`; names re-exported via `__all__` count as used; a bare
+`# noqa` or `# noqa: F401` on the import line suppresses; files under
+a path listed in per-file-ignores for F401 (here: __init__.py) are
+skipped. `from x import *` disables the check for that file (anything
+might be used downstream).
+
+Exit 0 clean, 1 findings — same contract as `ruff check`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_TREES = ("triton_dist_tpu", "tests", "scripts", "examples",
+              "benchmark")
+NOQA_MARKERS = ("# noqa", "#noqa")
+
+
+def _iter_files():
+    for tree in LINT_TREES:
+        root = os.path.join(REPO, tree)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    for fn in sorted(os.listdir(REPO)):
+        if fn.endswith(".py"):
+            yield os.path.join(REPO, fn)
+
+
+def _noqa_lines(src: str) -> set:
+    out = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        low = line.lower()
+        for m in NOQA_MARKERS:
+            at = low.find(m)
+            if at < 0:
+                continue
+            rest = low[at + len(m):].strip()
+            if not rest or not rest.startswith(":") or "f401" in rest:
+                out.add(i)
+    return out
+
+
+class _Imports(ast.NodeVisitor):
+    def __init__(self):
+        self.bound = []          # (name, lineno, shown)
+        self.used = set()
+        self.star = False
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.bound.append((name, node.lineno, a.name))
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # effectful, never "unused" (pyflakes semantics)
+        for a in node.names:
+            if a.name == "*":
+                self.star = True
+                continue
+            name = a.asname or a.name
+            self.bound.append((name, node.lineno,
+                               f"{node.module or '.'}.{a.name}"))
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        # names re-exported through __all__ arrive as string constants;
+        # counting every string is an over-approximation ruff also makes
+        # cheap versions of — fine for a fallback that must never
+        # false-positive
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.used.add(node.value)
+
+
+def lint_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:  # a syntax error is its own finding
+        return [(path, e.lineno or 0, f"E999 syntax error: {e.msg}")]
+    if os.path.basename(path) == "__init__.py":
+        return []  # per-file-ignores: facades re-export
+    v = _Imports()
+    v.visit(tree)
+    if v.star:
+        return []
+    noqa = _noqa_lines(src)
+    out = []
+    for name, lineno, shown in v.bound:
+        if name == "_":
+            continue
+        if name not in v.used and lineno not in noqa:
+            out.append((path, lineno,
+                        f"F401 `{shown}` imported but unused"))
+    return out
+
+
+def main() -> int:
+    findings = []
+    for path in _iter_files():
+        findings.extend(lint_file(path))
+    for path, lineno, msg in findings:
+        print(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    print(f"lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

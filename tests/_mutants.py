@@ -1,0 +1,133 @@
+"""Mutant corpus: deliberately broken semaphore protocols the verifier
+MUST flag, each with the diagnostic class it must be flagged with.
+
+These are the bug classes that have actually bitten signal/wait kernels
+in this codebase's history (and the reference's): the slot-by-absolute-
+rank indexing is the exact class PR 2's chunked A2A had to design
+around; the no-credit ring is the skew-only corruption the RS ring's
+credit flow control exists for. `scripts/verify_kernels.py --mutants`
+exits 1 unless EVERY mutant here is flagged with its expected class —
+the verifier's own regression harness (a checker that stops seeing
+seeded bugs is worse than no checker).
+
+Importing this module populates `verify.registry.mutants()`; it is not
+part of the package so shipped installs never carry broken protocols.
+"""
+
+from triton_dist_tpu import verify as _v
+from triton_dist_tpu.lang import shmem
+
+_AXIS = "tp"
+
+
+def _chunked_a2a(n, q, *, recv_slot, do_wait_recv=True, swap_sems=False):
+    """The chunked-A2A skeleton with injectable defects. recv_slot:
+    (i, c, peer, me) -> semaphore slot index tuple."""
+    me = shmem.my_pe(_AXIS)
+    x, o = _v.ref("x"), _v.ref("out")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    shmem.barrier_all(_AXIS)
+    local = [_v.copy(o.at(me, c), x.at(me, c), recv.at(0, c))
+             for c in range(q)]
+    handles = {}
+    for i in range(1, n):
+        peer = (me + i) % n
+        for c in range(q):
+            slot = recv_slot(i, c, peer, me)
+            if swap_sems:
+                handles[(i, c)] = shmem.putmem_nbi(
+                    o.at(me, c), x.at(peer, c), recv.at(*slot),
+                    send.at(), peer, _AXIS)
+            else:
+                handles[(i, c)] = shmem.putmem_nbi(
+                    o.at(me, c), x.at(peer, c), send.at(),
+                    recv.at(*slot), peer, _AXIS)
+    for c in range(q):
+        local[c].wait()
+        for i in range(1, n):
+            if do_wait_recv:
+                handles[(i, c)].wait()
+            else:
+                handles[(i, c)].wait_send()  # delivery wait DROPPED
+        for j in range(n):
+            _v.read(o.at(j, c))
+
+
+@_v.mutant("a2a_dropped_wait", expect=_v.RACE,
+           doc="receiver consumes chunk c without waiting its delivery "
+               "semaphores — reads race the in-flight remote writes")
+def _a2a_dropped_wait(n, q=2):
+    _chunked_a2a(n, q, recv_slot=lambda i, c, peer, me: (i, c),
+                 do_wait_recv=False)
+
+
+@_v.mutant("a2a_abs_rank_slot", expect=_v.DEADLOCK,
+           doc="delivery slot indexed by ABSOLUTE destination rank "
+               "instead of ring step (source offset): every sender "
+               "signals slot [dest], every receiver waits slot "
+               "[me+i] — unsatisfiable (the PR-2 bug class)")
+def _a2a_abs_rank_slot(n, q=2):
+    _chunked_a2a(n, q, recv_slot=lambda i, c, peer, me: (peer, c))
+
+
+@_v.mutant("a2a_swapped_sems", expect=_v.RACE,
+           doc="send/recv semaphores swapped in the DMA descriptor: "
+               "the 'delivery' wait is satisfied by the LOCAL send "
+               "completion, so chunk reads race the remote writes")
+def _a2a_swapped_sems(n, q=2):
+    _chunked_a2a(n, q, recv_slot=lambda i, c, peer, me: (i, c),
+                 swap_sems=True)
+
+
+@_v.mutant("rs_ring_no_credit", expect=_v.RACE,
+           doc="RS ring with the credit flow control removed: symmetric "
+               "acc-slot reuse without discharge — a fast upstream "
+               "neighbor's step s+1 put lands in the slot step s is "
+               "still sending (corrupts only under skew)")
+def _rs_ring_no_credit(n):
+    me = shmem.my_pe(_AXIS)
+    x, o = _v.ref("x"), _v.ref("o")
+    acc, stage = _v.ref("acc"), _v.ref("stage")
+    ld, st = _v.sem("ld_sem"), _v.sem("st_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    right = (me + 1) % n
+    shmem.neighbor_barrier(_AXIS, me, n)
+    _v.copy(acc.at(0), x.at((me - 1) % n), ld.at()).wait()
+    for s in range(n - 1):
+        cur, nxt = s % 2, (s + 1) % 2
+        # no credit wait: the send reuses slots on trust
+        h = shmem.putmem_nbi(acc.at(nxt), acc.at(cur), send.at(),
+                             recv.at(nxt), right, _AXIS)
+        _v.copy(stage.at(), x.at((me - s - 2) % n), ld.at()).wait()
+        h.wait_send()
+        h.wait_recv()
+        _v.read(stage.at())
+        _v.read(acc.at(nxt))
+        _v.write(acc.at(nxt))
+    _v.copy(o.at(), acc.at((n - 1) % 2), st.at()).wait()
+
+
+@_v.mutant("ag_ring_leaky_signal", expect=_v.LEAK,
+           doc="ring AG that signals one extra delivery credit per "
+               "step and never consumes it: the kernel 'works' once "
+               "but leaves nonzero semaphores — breaks re-entrancy "
+               "(the next call's waits mis-satisfy)")
+def _ag_ring_leaky_signal(n):
+    me = shmem.my_pe(_AXIS)
+    x, o = _v.ref("x"), _v.ref("out")
+    lsem = _v.sem("local_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    extra = _v.sem("notify_sem")
+    shmem.neighbor_barrier(_AXIS, me, n)
+    lc = _v.copy(o.at(me), x.at(), lsem.at())
+    lc.wait()
+    for s in range(n - 1):
+        slot = (me - s) % n
+        h = shmem.putmem_nbi(o.at(slot), o.at(slot), send.at(),
+                             recv.at(s), (me + 1) % n, _AXIS)
+        # stray progress notification nobody waits for
+        shmem.signal(extra.at(), 1, shmem.SIGNAL_ADD, (me + 1) % n,
+                     _AXIS)
+        h.wait()
+    for j in range(n):
+        _v.read(o.at(j))

@@ -16,11 +16,8 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
-
 from triton_dist_tpu.models import (
     AutoLLM,
-    Engine,
     ModelConfig,
     config_from_hf,
     init_params,

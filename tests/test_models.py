@@ -16,7 +16,7 @@ from triton_dist_tpu.layers import (
     rms_norm,
     rope_table,
 )
-from triton_dist_tpu.models import Engine, KVCache, ModelConfig, init_params
+from triton_dist_tpu.models import Engine, ModelConfig
 
 TP = 8
 

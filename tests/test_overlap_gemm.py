@@ -20,7 +20,6 @@ from triton_dist_tpu.kernels import (
     gemm_rs,
     gemm_rs_ref,
     gemm_ar,
-    gemm_ar_ref,
     AgGemmConfig,
     GemmRsConfig,
 )

@@ -5,8 +5,6 @@ Analog of the reference's primitive tests `test_distributed_wait.py`,
 and tutorial 01 (notify-wait producer/consumer queue).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

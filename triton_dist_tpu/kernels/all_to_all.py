@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu import verify as _v
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
@@ -330,3 +331,87 @@ def all_to_all_chunked(
     out, out_splits = res[:2]
     return with_trace((out, out_splits.reshape(splits.shape)),
                       res[2] if build is not None else None)
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+#
+# Each model replays its kernel's cross-rank communication skeleton
+# through the shmem primitives under verify.capturing(): same barrier,
+# same DMA slot/semaphore indexing, same wait order, with the consumer
+# contract spelled as read annotations. scripts/verify_kernels.py proves
+# them deadlock-free / race-free / semaphore-balanced at n = 2/4/8.
+
+
+@_v.protocol("all_to_all",
+             doc="single-shot segment exchange (_a2a_kernel)")
+def _a2a_protocol(n):
+    me = shmem.my_pe(EP_AXIS)
+    x, s = _v.ref("x"), _v.ref("splits")
+    o, os_ = _v.ref("out"), _v.ref("out_splits")
+    cp = _v.sem("cp_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    msend, mrecv = _v.sem("meta_send_sem"), _v.sem("meta_recv_sem")
+    shmem.barrier_all(EP_AXIS)
+    lc = _v.copy(o.at(me), x.at(me), cp.at())
+    handles = []
+    for i in range(1, n):
+        peer = (me + i) % n
+        handles.append(shmem.putmem_nbi(
+            o.at(me), x.at(peer), send.at(), recv.at(), peer, EP_AXIS))
+        handles.append(shmem.putmem_nbi(
+            os_.at(me), s.at(peer), msend.at(), mrecv.at(), peer,
+            EP_AXIS))
+    lc.wait()
+    lcs = _v.copy(os_.at(me), s.at(me), cp.at())
+    lcs.wait()
+    for h in handles:
+        h.wait()
+    # consumer contract: the caller reads every segment after the kernel
+    for j in range(n):
+        _v.read(o.at(j))
+        _v.read(os_.at(j))
+
+
+@_v.protocol("all_to_all_chunked",
+             grid=({"q": 1}, {"q": 2}, {"q": 4}),
+             doc="per-(step, chunk) delivery slots (_a2a_chunked_kernel)")
+def _a2a_chunked_protocol(n, q=2):
+    """Slots indexed by RING STEP (source offset), never absolute rank —
+    the exact invariant the verifier's deadlock check proves (the
+    absolute-rank mutant in tests/_mutants.py is the counterexample).
+    Chunk-major consumer reads model the fused EP pipeline: chunk c of
+    every source is read while chunks c+1.. are still in flight."""
+    me = shmem.my_pe(EP_AXIS)
+    x, o = _v.ref("x"), _v.ref("out")
+    s, os_ = _v.ref("splits"), _v.ref("out_splits")
+    cp = _v.sem("cp_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    msend, mrecv = _v.sem("meta_send_sem"), _v.sem("meta_recv_sem")
+    shmem.barrier_all(EP_AXIS)
+    local = [_v.copy(o.at(me, c), x.at(me, c), recv.at(0, c))
+             for c in range(q)]
+    handles = {}
+    metas = []
+    for i in range(1, n):
+        peer = (me + i) % n
+        for c in range(q):
+            with _v.tag(step=i, chunk=c):
+                handles[(i, c)] = shmem.putmem_nbi(
+                    o.at(me, c), x.at(peer, c), send.at(), recv.at(i, c),
+                    peer, EP_AXIS)
+        metas.append(shmem.putmem_nbi(
+            os_.at(me), s.at(peer), msend.at(), mrecv.at(), peer,
+            EP_AXIS))
+    for c in range(q):
+        local[c].wait()
+        for i in range(1, n):
+            with _v.tag(step=i, chunk=c):
+                handles[(i, c)].wait()
+        for j in range(n):
+            _v.read(o.at(j, c))  # chunk-major consumer (EP FFN)
+    lcs = _v.copy(os_.at(me), s.at(me), cp.at())
+    lcs.wait()
+    for m in metas:
+        m.wait()
+    for j in range(n):
+        _v.read(os_.at(j))

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import enum
 import functools
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -220,3 +220,46 @@ def all_gather_op(
     """Host-level AG on a global array sharded along its leading dim
     (ref host entry: allgather.py:263-338 dispatch wrappers)."""
     return _ag_op_jit(mesh, axis, method)(arr)
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+@_v.protocol("allgather",
+             grid=({"method": "ring"}, {"method": "full_mesh"}),
+             doc="ring AG (_ring_ag_kernel) / full-mesh push "
+                 "(fcollect)")
+def _ag_protocol(n, method="ring", prefix=""):
+    """Ring: step s forwards chunk (me-s) to the right neighbor on the
+    per-step recv semaphore (a shared one would let step s's wait be
+    satisfied by a step s+k arrival — the race the per-step slots
+    exist to prevent, and the one the verifier would flag). Full-mesh:
+    the fcollect primitive, shared recv semaphore made exact by the
+    full wait before any slot is consumed.
+
+    `prefix` namespaces buffers/semaphores when this skeleton is
+    embedded in a larger protocol (two-shot allreduce)."""
+    me = shmem.my_pe(TP_AXIS)
+    x, o = _v.ref(prefix + "x"), _v.ref(prefix + "out")
+    lsem = _v.sem(prefix + "local_sem")
+    send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sem")
+    if method == "full_mesh":
+        shmem.barrier_all(TP_AXIS)
+        shmem.fcollect(o, x, lsem.at(), send.at(), recv.at(), TP_AXIS, n)
+        for j in range(n):
+            _v.read(o.at(j))
+        return
+    shmem.neighbor_barrier(TP_AXIS, me, n)
+    lc = _v.copy(o.at(me), x.at(), lsem.at())
+    lc.wait()
+    for s in range(n - 1):
+        slot = (me - s) % n
+        h = shmem.putmem_nbi(o.at(slot), o.at(slot), send.at(),
+                             recv.at(s), (me + 1) % n, TP_AXIS)
+        # wait our send AND the incoming chunk (me-s-1) — next step's
+        # send source; program order is the dependency chain
+        h.wait()
+    for j in range(n):
+        _v.read(o.at(j))

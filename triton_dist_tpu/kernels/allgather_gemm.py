@@ -590,3 +590,37 @@ def ag_gemm_ref(a_shard: jax.Array, b: jax.Array, axis: str = TP_AXIS):
     return jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
         a_shard.dtype
     )
+
+
+# -- protocol model (static verifier, triton_dist_tpu.verify) ----------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+@_v.protocol("allgather_gemm",
+             doc="AG+GEMM producer ring (_ag_gemm_kernel, need_ws "
+                 "n>1 regime) with the per-ring-step consumer reads")
+def _ag_gemm_protocol(n):
+    """The producer ring of _ag_gemm_kernel: publish the local shard
+    into ws[me], forward chunk (me-s) right each step on per-step recv
+    semaphores, and CONSUME (GEMM-read) step s's rows only after that
+    step's delivery wait — the in-kernel producer/consumer contract the
+    `ag.ring_wait` trace spans measure dynamically."""
+    me = shmem.my_pe(TP_AXIS)
+    a, ws = _v.ref("a"), _v.ref("ws")
+    cp = _v.sem("cp_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    shmem.neighbor_barrier(TP_AXIS, me, n)
+    _v.read(a.at())  # step-0 consumer reads the own shard from a_ref
+    lc = _v.copy(ws.at(me), a.at(), cp.at())
+    lc.wait()
+    prev = shmem.putmem_nbi(ws.at(me), ws.at(me), send.at(), recv.at(0),
+                            (me + 1) % n, TP_AXIS)
+    for s in range(1, n):
+        prev.wait()  # our step s-1 send drained + step s-1 rows landed
+        chunk = (me - s) % n
+        _v.read(ws.at(chunk))  # this step's GEMM reads
+        if s < n - 1:
+            prev = shmem.putmem_nbi(ws.at(chunk), ws.at(chunk),
+                                    send.at(), recv.at(s),
+                                    (me + 1) % n, TP_AXIS)

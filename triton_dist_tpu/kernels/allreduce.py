@@ -198,3 +198,45 @@ def _ar_op_jit(mesh, axis: str, method: AllReduceMethod):
         jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=P(),
                       check_vma=False)
     )
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+@_v.protocol("allreduce",
+             grid=({"method": "one_shot"}, {"method": "two_shot"}),
+             doc="one-shot full-mesh push AR / two-shot RS+AG ring "
+                 "composition")
+def _ar_protocol(n, method="one_shot"):
+    if method == "two_shot":
+        # the composition IS the protocol: ring RS then ring AG, each
+        # with its own kernel-local semaphores (namespaced here so the
+        # verifier sees two disjoint semaphore sets, as at run time)
+        from triton_dist_tpu.kernels.reduce_scatter import _rs_protocol
+        from triton_dist_tpu.kernels.allgather import _ag_protocol
+
+        _rs_protocol(n, prefix="rs.")
+        _ag_protocol(n, method="ring", prefix="ag.")
+        return
+    me = shmem.my_pe(TP_AXIS)
+    x, o = _v.ref("x"), _v.ref("o")
+    ws, acc = _v.ref("ws"), _v.ref("acc")
+    ld = _v.sem("ld_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    shmem.barrier_all(TP_AXIS)
+    lc = _v.copy(ws.at(me), x.at(), ld.at())
+    handles = [
+        shmem.putmem_nbi(ws.at(me), x.at(), send.at(), recv.at(),
+                         (me + i) % n, TP_AXIS)
+        for i in range(1, n)
+    ]
+    lc.wait()
+    for h in handles:
+        h.wait()
+    for r in range(n):
+        _v.read(ws.at(r))  # the local reduction over all slots
+    _v.write(acc.at())
+    st = _v.copy(o.at(), acc.at(), ld.at())
+    st.wait()

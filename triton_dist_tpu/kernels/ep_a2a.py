@@ -638,3 +638,32 @@ def ep_moe_pipeline(
         "ep.combine.a2a": comb_tbuf,
     }
     return out, disp.drops, traces
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+#
+# The chunk-pipelined dispatch and combine legs ride all_to_all_chunked
+# unchanged — the pack/unpack around them is pure jnp with no cross-rank
+# protocol content — so their registered models ARE the chunked-A2A
+# skeleton at the chunk counts the EP pipeline uses. Registering them
+# separately keeps the kernel list in scripts/verify_kernels.py honest
+# (a future ep-specific transport change must bring its own model).
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+from triton_dist_tpu.kernels.all_to_all import (  # noqa: E402
+    _a2a_chunked_protocol,
+)
+
+
+@_v.protocol("ep_dispatch_chunked", grid=({"q": 2}, {"q": 4}),
+             doc="EP dispatch leg over the chunked A2A (tokens + "
+                 "per-(dest, expert) counts in the metadata row)")
+def _ep_dispatch_protocol(n, q=2):
+    _a2a_chunked_protocol(n, q=q)
+
+
+@_v.protocol("ep_combine_chunked", grid=({"q": 2}, {"q": 4}),
+             doc="EP combine return leg (chunk-streamed scatter-add "
+                 "consumer) over the chunked A2A")
+def _ep_combine_protocol(n, q=2):
+    _a2a_chunked_protocol(n, q=q)

@@ -546,3 +546,27 @@ def gemm_rs_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     """Unfused XLA reference path."""
     partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
     return jax.lax.psum_scatter(partial, axis, tiled=True)
+
+
+# -- protocol model (static verifier, triton_dist_tpu.verify) ----------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: E402
+    _ring_rs_skeleton,
+)
+
+
+@_v.protocol("gemm_reduce_scatter",
+             doc="GEMM+RS producer ring (_rs_ring): the RS credit ring "
+                 "with the stage filled by the partial GEMM")
+def _gemm_rs_protocol(n):
+    a, b = _v.ref("a"), _v.ref("b")
+
+    def fill_stage(s):
+        # partial_fn: synchronous MXU fill of acc[0] / stage from the
+        # rank-local A chunk and B shard (no cross-rank content beyond
+        # the ring the skeleton carries)
+        _v.read(a.at())
+        _v.read(b.at())
+
+    _ring_rs_skeleton(n, fill_stage)

@@ -179,3 +179,35 @@ def ll_all_gather_op(
     )
     workspace.update(name, new_buf)
     return out
+
+
+# -- protocol model (static verifier, triton_dist_tpu.verify) ----------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+@_v.protocol("low_latency_allgather",
+             grid=({"calls": 1}, {"calls": 3}),
+             doc="parity double-buffered LL AG: entry barrier on call 0 "
+                 "only; calls=3 exercises the same-parity slot reuse "
+                 "(call k+2) the parity counting protocol protects")
+def _ll_ag_protocol(n, calls=3):
+    """Back-to-back _ll_ag_kernel calls on one context buffer. The
+    barrier-free steady state is the point: call k+2 reuses parity
+    k%2's slots and semaphores, and its safety rests on the counting
+    chain (my call-k+1 waits consumed every peer's call-k+1 delivery,
+    which is program-ordered after their call-k consumption) — the HB
+    argument the verifier replays, not a barrier."""
+    x, buf = _v.ref("x"), _v.ref("buf")
+    lsem = _v.sem("local_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    for k in range(calls):
+        parity = k % 2
+        if k == 0:
+            shmem.barrier_all(TP_AXIS)  # fresh-context entry barrier
+        shmem.fcollect_slots(
+            lambda pe: buf.at(parity, pe), x,
+            lsem.at(), send.at(), recv.at(parity), TP_AXIS, n,
+        )
+        for j in range(n):
+            _v.read(buf.at(parity, j))  # consume the gathered slots

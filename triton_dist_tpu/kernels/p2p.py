@@ -155,3 +155,39 @@ def ring_shift(x: jax.Array, shift: int = 1, axis: str = PP_AXIS) -> jax.Array:
             collective_id=next_collective_id(f"ring_shift_{axis}"),
         ),
     )(x)
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+@_v.protocol("broadcast", grid=({"root": 0}, {"root": 1}),
+             doc="team broadcast (lang/shmem.broadcast): root-guarded "
+                 "fan-out, non-root single delivery wait")
+def _broadcast_protocol(n, root=0):
+    """Exercises the rank-divergent guard machinery (capture `when`):
+    only the root records the fan-out puts, only non-roots the delivery
+    wait — the same divergence the real kernel expresses with pl.when.
+    The entry barrier is the documented caller precondition."""
+    src, dst = _v.ref("src"), _v.ref("dst")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    shmem.barrier_all(PP_AXIS)
+    shmem.broadcast(dst, src, send.at(), recv.at(), root, PP_AXIS, n)
+    _v.read(dst.at())  # every rank consumes the broadcast payload
+
+
+@_v.protocol("ring_shift", grid=({"shift": 1}, {"shift": 3}),
+             doc="PP stage handoff: every rank puts `shift` hops right")
+def _ring_shift_protocol(n, shift=1):
+    me = shmem.my_pe(PP_AXIS)
+    x, o = _v.ref("x"), _v.ref("o")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    if abs(shift) == 1:
+        shmem.neighbor_barrier(PP_AXIS, me, n)
+    else:
+        shmem.barrier_all(PP_AXIS)
+    h = shmem.putmem_nbi(o.at(), x.at(), send.at(), recv.at(),
+                         (me + shift) % n, PP_AXIS)
+    h.wait()
+    _v.read(o.at())

@@ -261,3 +261,71 @@ def _rs_op_jit(mesh, axis: str, method: ReduceScatterMethod):
         jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+
+
+def _ring_rs_skeleton(n, fill_stage, prefix=""):
+    """The shared RS producer ring protocol (_ring_rs_kernel /
+    gemm_reduce_scatter._rs_ring): credit flow control toward the left
+    neighbor, parity-indexed recv semaphores, double-buffered acc slots.
+    `fill_stage(s)` supplies the per-step stage fill — an async x-chunk
+    load here, a synchronous partial-GEMM write in the fused kernel —
+    so both kernels share ONE verified skeleton, exactly as they share
+    the runtime ring.
+
+    The credit protocol is what makes the acc slot reuse safe: the
+    verifier proves it by the HB chain my wait_send -> my credit grant
+    -> left's credit wait -> left's next put into that slot (drop the
+    credits and the race detector fires — tests/_mutants.py
+    rs_ring_no_credit)."""
+    me = shmem.my_pe(TP_AXIS)
+    o = _v.ref(prefix + "o")
+    acc, stage = _v.ref(prefix + "acc"), _v.ref(prefix + "stage")
+    st = _v.sem(prefix + "st_sem")
+    send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sems")
+    credit = _v.sem(prefix + "credit_sem")
+    left, right = (me - 1) % n, (me + 1) % n
+    shmem.neighbor_barrier(TP_AXIS, me, n)
+    # step-0 incoming targets our slot 1, free from the start
+    shmem.signal(credit.at(), 1, shmem.SIGNAL_ADD, left, TP_AXIS)
+    # our contribution to the first travelling chunk -> acc[0]
+    fill_stage(-1)
+    _v.write(acc.at(0))
+    for s in range(n - 1):
+        cur, nxt = s % 2, (s + 1) % 2
+        shmem.signal_wait_until(credit.at(), shmem.CMP_GE, 1)
+        h = shmem.putmem_nbi(acc.at(nxt), acc.at(cur), send.at(),
+                             recv.at(nxt), right, TP_AXIS)
+        fill_stage(s)
+        _v.write(stage.at())
+        h.wait_send()
+        if s + 1 <= n - 2:
+            # slot cur is drained: receivable for incoming step s+1
+            shmem.signal(credit.at(), 1, shmem.SIGNAL_ADD, left, TP_AXIS)
+        h.wait_recv()
+        _v.read(stage.at())
+        _v.read(acc.at(nxt))
+        _v.write(acc.at(nxt))  # acc[nxt] += stage
+    fc = _v.copy(o.at(), acc.at((n - 1) % 2), st.at())
+    fc.wait()
+
+
+@_v.protocol("reduce_scatter",
+             doc="credit-flow ring RS (_ring_rs_kernel)")
+def _rs_protocol(n, prefix=""):
+    x = _v.ref(prefix + "x")
+    ld = _v.sem(prefix + "ld_sem")
+
+    def fill_stage(s):
+        # async load of our contribution; finish() runs before the read
+        me = shmem.my_pe(TP_AXIS)
+        chunk = (me - 1) % n if s < 0 else (me - s - 2) % n
+        dst = (_v.ref(prefix + "acc").at(0) if s < 0
+               else _v.ref(prefix + "stage").at())
+        _v.copy(dst, x.at(chunk), ld.at()).wait()
+
+    _ring_rs_skeleton(n, fill_stage, prefix=prefix)

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import _compat
+from triton_dist_tpu.verify import capture as _vcap
 
 _compat.install()
 
@@ -54,12 +55,20 @@ AxisName = Union[str, Sequence[str]]
 
 
 def my_pe(axis: AxisName) -> jax.Array:
-    """This device's rank within the team (ref: nvshmem_my_pe)."""
+    """This device's rank within the team (ref: nvshmem_my_pe).
+
+    Under verify.capturing(): the symbolic rank (every primitive below
+    likewise records instead of executing — see verify/capture.py)."""
+    if _vcap.active() is not None:
+        return _vcap.Sym.var("me")
     return jax.lax.axis_index(axis)
 
 
 def n_pes(axis: AxisName) -> jax.Array:
     """Team size (ref: nvshmem_n_pes)."""
+    cap = _vcap.active()
+    if cap is not None:
+        return cap.n
     return jax.lax.axis_size(axis)
 
 
@@ -126,6 +135,9 @@ def putmem_nbi(
     i.e. every put is implicitly a put-with-signal; `putmem_signal_nbi`
     below only differs by signal amount.
     """
+    cap = _vcap.active()
+    if cap is not None:
+        return cap.put(dst_ref, src_ref, send_sem, recv_sem, pe)
     device_id, id_type = _dma_device_id(axis, pe)
     copy = pltpu.make_async_remote_copy(
         src_ref=src_ref,
@@ -185,6 +197,10 @@ def signal(sig_sem, value, sig_op, pe, axis: AxisName) -> None:
             "SIGNAL_SET on TPU is only supported as set-to-1 on a zeroed "
             "semaphore (== ADD 1); use SIGNAL_ADD otherwise"
         )
+    cap = _vcap.active()
+    if cap is not None:
+        cap.signal(sig_sem, value, pe)
+        return
     pltpu.semaphore_signal(
         sig_sem,
         inc=value,
@@ -195,6 +211,10 @@ def signal(sig_sem, value, sig_op, pe, axis: AxisName) -> None:
 
 def signal_local(sig_sem, value=1) -> None:
     """Signal this device's own semaphore."""
+    cap = _vcap.active()
+    if cap is not None:
+        cap.signal(sig_sem, value, pe=None)
+        return
     pltpu.semaphore_signal(sig_sem, inc=value)
 
 
@@ -205,11 +225,21 @@ def signal_wait_until(sig_sem, cmp, value) -> None:
     Only CMP_GE is supported — TPU semaphore waits are ">= then subtract";
     NVSHMEM's EQ (wait for exact value, non-consuming) cannot be expressed."""
     assert cmp == CMP_GE, "TPU signal_wait_until supports CMP_GE only"
+    cap = _vcap.active()
+    if cap is not None:
+        cap.wait(sig_sem, value)
+        return
     pltpu.semaphore_wait(sig_sem, value)
 
 
 def signal_read(sig_sem) -> jax.Array:
     """Non-destructive semaphore read (ref: atomic load of signal word)."""
+    if _vcap.active() is not None:
+        raise RuntimeError(
+            "signal_read has no symbolic model (its VALUE would steer "
+            "control flow the verifier cannot see) — protocols under "
+            "verify.capturing() must be wait-structured"
+        )
     return pl.semaphore_read(sig_sem)
 
 
@@ -234,6 +264,10 @@ def barrier_all(axis: AxisName) -> None:
     targets per hop. Requires the surrounding pallas_call to set a
     collective_id (compiler_params) so all devices agree on the barrier
     semaphore."""
+    cap = _vcap.active()
+    if cap is not None:
+        cap.barrier()
+        return
     if isinstance(axis, str):
         n = jax.lax.axis_size(axis)
     else:
@@ -265,6 +299,17 @@ def neighbor_barrier(axis: str, me, n: int) -> None:
     entered the kernel. Cheaper than barrier_all when only neighbors
     communicate (ref: the cuStreamWriteValue barrier preambles of
     kernels/nvidia/allgather.py:106-138)."""
+    cap = _vcap.active()
+    if cap is not None:
+        # recorded as its exact sem decomposition — a neighbor sync is
+        # NOT a full barrier cut, and modeling it as one would invent
+        # happens-before the hardware does not provide
+        bsem = _vcap.SymSem("__nbar__").at()
+        for d in ((me - 1 + n) % n, (me + 1) % n):
+            cap.signal(bsem, 1, d)
+        cap.wait(bsem, 2)
+        return
+
     def with_sem(bsem):
         for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
             pltpu.semaphore_signal(
@@ -304,6 +349,8 @@ def straggler_delay(axis: AxisName, rank, nanos: int, sem=None) -> None:
     land on different cores' semaphore instances and deadlock; such
     kernels must implement their own delay from per-core primitives
     (e.g. a local-DMA churn — see the megakernel AR branch)."""
+    if _vcap.active() is not None:
+        return  # pure timing perturbation: no protocol content to model
     if nanos <= 0:
         return
     from triton_dist_tpu.lang.core import use_interpret
@@ -367,9 +414,14 @@ def getmem_nbi(
     me = my_pe(axis)
     n = n_pes(axis)
     if reader_pe is None:
-        # infer the matched shift: from_pe = me + d  =>  reader = me - d
-        d = jax.lax.rem(from_pe - me + n, n)
-        reader_pe = jax.lax.rem(me - d + n, n)
+        if _vcap.active() is not None:
+            # symbolic shift inference (me is a Sym; python arithmetic)
+            d = (from_pe - me + n) % n
+            reader_pe = (me - d + n) % n
+        else:
+            # infer the matched shift: from_pe = me+d  =>  reader = me-d
+            d = jax.lax.rem(from_pe - me + n, n)
+            reader_pe = jax.lax.rem(me - d + n, n)
     return putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, reader_pe,
                       axis)
 
@@ -391,6 +443,22 @@ def broadcast(dst_ref, src_ref, send_sem, recv_sem, root, axis: str,
     barrier the team before the FIRST collective of a kernel (same
     precondition as fcollect): a put must never land in a peer that has
     not yet entered the kernel."""
+    cap = _vcap.active()
+    if cap is not None:
+        me = _vcap.Sym.var("me")
+        with cap.when(me == root):
+            cp = cap.copy(dst_ref, src_ref, send_sem)
+            handles = [
+                putmem_nbi(dst_ref, src_ref, send_sem, recv_sem,
+                           (root + i) % n, axis)
+                for i in range(1, n)
+            ]
+            cp.wait()
+            for h in handles:
+                h.wait_send()
+        with cap.when(me != root):
+            cap.wait(recv_sem, 1)
+        return
     if _compat.legacy_interpret_active():
         # The 0.4.x interpreter discharges remote DMA through lockstep
         # all_gathers: the divergent root-only send below would deadlock
@@ -435,6 +503,21 @@ def fcollect_slots(slot_ref_of, src_ref, local_sem, send_sem, recv_sem,
     must return the rank-`me` slot ref of the (symmetric) destination.
     Used directly by kernels whose gather target is not row-flat (e.g.
     the parity-buffered low-latency allgather)."""
+    cap = _vcap.active()
+    if cap is not None:
+        me = _vcap.Sym.var("me")
+        cp = cap.copy(slot_ref_of(me), src_ref, local_sem)
+        handles = []
+        for i in range(1, n):
+            peer = (me + i) % n
+            handles.append(
+                putmem_nbi(slot_ref_of(me), src_ref, send_sem, recv_sem,
+                           peer, axis)
+            )
+        cp.wait()
+        for h in handles:
+            h.wait()
+        return
     me = my_pe(axis)
 
     cp = pltpu.make_async_copy(src_ref, slot_ref_of(me), local_sem)
@@ -460,6 +543,10 @@ def fcollect(dst_ref, src_ref, local_sem, send_sem, recv_sem,
     the device-side allgather primitive). Full-mesh push: each rank puts
     its shard into slot `me` of all peers. Caller must barrier the team
     before first use (see kernels/allgather.py full-mesh kernel)."""
+    if _vcap.active() is not None:
+        fcollect_slots(lambda me: dst_ref.at(me), src_ref, local_sem,
+                       send_sem, recv_sem, axis, n)
+        return
     m = src_ref.shape[0]
     fcollect_slots(
         lambda me: dst_ref.at[pl.ds(me * m, m)],

@@ -795,20 +795,48 @@ def validate_schedule(graph: Graph, sched: Schedule) -> None:
             )
 
 
+def task_hb_graph(sched: Schedule) -> "HBGraph":
+    """The multi-core execution's happens-before DAG on task ids, built
+    on the shared verify.hb engine (one HB implementation for protocol
+    verification AND schedule validation): same-queue program order plus
+    one edge per monotone-watermark wait (task u waiting wm[u, c] = w
+    starts after task (c, w-1) completes). Edge semantics are
+    completion(a) <= start(b), so `reaches(u, d)` iff task u is fully
+    drained before task d can run — the slot-reuse safety predicate."""
+    from triton_dist_tpu.verify.hb import HBGraph
+
+    g = HBGraph()
+    for t in range(len(sched.core)):
+        g.add_node(t)
+    for q in sched.queues:
+        for a, b in zip(q, q[1:]):
+            g.add_edge(a, b)
+    wm = monotone_watermarks(sched)
+    core = np.asarray(sched.core)
+    by_cp = {(int(core[t]), int(sched.pos[t])): t
+             for t in range(len(core))}
+    for u in range(len(core)):
+        for c in range(wm.shape[1]):
+            w = int(wm[u, c])
+            if w > 0 and c != core[u]:
+                g.add_edge(by_cp[(c, w - 1)], u)
+    return g
+
+
 def _validate_slots_hb(graph: Graph, sched: Schedule) -> None:
     """Multi-core slot check: for each pair of buffers sharing a slot,
     one buffer's every accessor must happen-before the other's defining
-    task (recomputed independently of the planner's choices)."""
-    A = after_vectors(sched, monotone_watermarks(sched))
-    core = np.asarray(sched.core)
-    pos = np.asarray(sched.pos)
+    task (recomputed independently of the planner's choices — the
+    planner proves via `after_vectors` position minima, the validator
+    via shared-engine reachability; their agreement is the check)."""
+    g = task_hb_graph(sched)
     def_task, users = _buffer_users(graph)
 
     def all_before(b1: int, b2: int) -> bool:
         d = def_task[b2]
         if d < 0:
             return False
-        return all(pos[d] >= A[u][core[d]] for u in users[b1])
+        return all(g.reaches(u, d) for u in users[b1])
 
     by_slot: dict = {}
     for b in graph.buffers:

@@ -109,6 +109,20 @@ PHASE_DISPATCH = 1
 PHASE_FFN = 2
 PHASE_COMBINE = 3
 
+# Shared verify/trace event taxonomy: which trace region OBSERVES each
+# static-verifier op kind at run time, per instrumented protocol. The
+# static HB engine (verify/engine.py) proves ordering over "put" and
+# "wait_recv" ops; the trace subsystem measures the same events as
+# "a2a.send" instants and "a2a.wait" spans — tests/test_verify.py
+# cross-validates the verifier's delivery edges against the
+# a2a_step_waits replay through this table, so the two subsystems can
+# never silently disagree about what a protocol event is.
+VERIFY_OP_REGIONS = {
+    "all_to_all_chunked": {"put": "a2a.send", "wait_recv": "a2a.wait"},
+    "allgather_gemm": {"wait_recv": "ag.ring_wait"},
+    "gemm_reduce_scatter": {"wait": "rs.credit", "wait_recv": "rs.hop"},
+}
+
 
 def region_id(name: str) -> int:
     return REGIONS[name]

@@ -22,7 +22,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-from typing import Dict, Optional
+from typing import Optional
 
 from triton_dist_tpu.trace import events as ev
 from triton_dist_tpu.trace.collect import MalformedTrace, Timeline

@@ -1,0 +1,73 @@
+"""triton_dist_tpu.verify — static race/deadlock verifier for the
+cross-rank semaphore protocols.
+
+The hardest bugs in signal/wait-style kernels are protocol bugs: a
+dropped `signal_wait_until`, a semaphore slot indexed by absolute rank
+instead of source offset, a symmetric buffer reused before its
+outbound DMA drained. The trace subsystem (ISSUE 3) can only catch
+these DYNAMICALLY, on the schedule that happened to run; this package
+proves them absent STATICALLY:
+
+    with verify.capturing(n) as cap:
+        my_protocol(n)               # shmem primitives record, not run
+    ex = verify.run_protocol(my_protocol, n)
+    ex.findings                      # deadlock / data-race / sem-leak
+
+Every shipped collective registers a protocol model next to its kernel
+(`verify.registry`); `verify_shipped()` — and its CLI face,
+`scripts/verify_kernels.py` — concretizes each at n = 2/4/8, builds
+the cross-rank happens-before graph (program order + signal->satisfied-
+wait edges + barrier cuts), and reports semaphore imbalance, deadlock,
+and data races. The HB core (`verify.hb.HBGraph`) is shared with the
+megakernel scheduler's multi-core slot validator.
+
+Capture is zero-cost when off: outside a `capturing()` block the shmem
+primitives compile the exact same kernels (bit-identical outputs,
+unchanged pallas_call_count — tests/test_verify.py enforces both).
+
+docs/verification.md has the diagnostic classes, the how-to for
+annotating a new kernel, and the known false-positive/negative limits.
+"""
+
+from triton_dist_tpu.verify.capture import (  # noqa: F401
+    Capture,
+    Slot,
+    Sym,
+    SymRef,
+    SymSem,
+    active,
+    capturing,
+    copy,
+    me,
+    nranks,
+    read,
+    ref,
+    sem,
+    tag,
+    when,
+)
+from triton_dist_tpu.verify.capture import write  # noqa: F401
+from triton_dist_tpu.verify.engine import (  # noqa: F401
+    CLASSES,
+    DEADLOCK,
+    LEAK,
+    RACE,
+    Execution,
+    Finding,
+    check_protocol,
+    check_races,
+    concretize,
+    execute,
+    run_protocol,
+)
+from triton_dist_tpu.verify.hb import CycleError, HBGraph  # noqa: F401
+from triton_dist_tpu.verify.registry import (  # noqa: F401
+    ProtocolSpec,
+    load_shipped,
+    mutant,
+    mutants,
+    protocol,
+    shipped,
+    verify_shipped,
+    verify_spec,
+)

@@ -1,0 +1,467 @@
+"""Happens-before engine: concretize a captured protocol and prove it
+deadlock-free, race-free, and semaphore-balanced at small team sizes.
+
+Pipeline (per (protocol, n)):
+
+  1. `concretize` — evaluate the symbolic SPMD op list per rank
+     (env: me=r), filter guarded ops, assign barrier rounds.
+  2. `execute` — run all ranks to completion under a greedy maximal
+     scheduler, building the cross-rank HB graph (verify/hb.HBGraph):
+     program-order edges, signal->satisfied-wait edges, barrier cuts,
+     and the async DMA structure (a put spawns a send-completion node S
+     carrying the source read and a delivery node D carrying the
+     destination write; S/D are ordered only through the semaphore
+     tokens they increment).
+  3. analyses — deadlock (stuck ranks: unsatisfiable wait / wait-for
+     cycle / barrier mismatch), semaphore balance per (rank, sem, slot)
+     (leftover signals break re-entrancy; missing ones already
+     deadlocked), data races (conflicting same-slot accesses unordered
+     by HB — this statically subsumes the legacy-discharge slot-
+     aliasing rule: a slot keyed by absolute rank instead of source
+     offset shows up as an unsatisfiable wait + orphan deliveries).
+
+Greedy maximal execution is sufficient for deadlock detection here
+because every semaphore counter has a SINGLE consumer stream (waits are
+local and program-ordered on their rank), which makes the transition
+system confluent: if the maximal run gets stuck, every interleaving
+does.
+
+HB edge soundness for consumed tokens (`_wait_edges`):
+
+  - single producer RANK for the slot -> FIFO by that rank's program
+    order (remote DMA/signals from one rank to one destination are
+    delivered in connection order; local completions in the shipped
+    kernels are <=1-outstanding or full-tally — docs/verification.md
+    "known limits");
+  - a wait whose cumulative consumption reaches the slot's whole-
+    program production total -> edges from ALL producers (no token can
+    be outstanding);
+  - otherwise: NO edge at execution time; the post-execution FIXPOINT
+    (`_refine_tally_edges`) then adds edges from every producer not
+    provably after the wait whenever those producers' amounts sum
+    exactly to the wait's cumulative consumption — tokens only come
+    from producers, so if the not-after set is exactly large enough,
+    all of it must have fired. This is what proves the LL allgather's
+    barrier-free steady state (same parity slot re-produced two calls
+    later) without a false race. Anything still unresolved stays
+    conservative: a possible race is reported, never suppressed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from triton_dist_tpu.verify import capture as cap
+from triton_dist_tpu.verify.hb import HBGraph
+
+# diagnostic classes (docs/verification.md)
+DEADLOCK = "deadlock"
+RACE = "data-race"
+LEAK = "sem-leak"
+CLASSES = (DEADLOCK, RACE, LEAK)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    klass: str          # one of CLASSES
+    message: str        # one line, rank-specific
+    kernel: str = "?"   # registry name (filled by the runner)
+    n: int = 0          # team size of the concretization
+    params: tuple = ()  # sorted (key, value) protocol params
+
+    def __str__(self):
+        p = f" {dict(self.params)}" if self.params else ""
+        return f"[{self.klass}] {self.kernel} n={self.n}{p}: {self.message}"
+
+
+@dataclasses.dataclass
+class COp:
+    """One concretized (per-rank) op."""
+
+    kind: str
+    rank: int
+    f: dict            # resolved fields (slot keys, pe, amount, round)
+    tag: Optional[dict]
+    sid: int           # capture op id (symmetric across ranks)
+    pidx: int = 0      # program index on this rank
+
+    def __repr__(self):
+        return f"<r{self.rank}#{self.pidx} {self.kind} {self.f}>"
+
+
+def concretize(ops: List[cap.Op], n: int) -> List[List[COp]]:
+    """Symbolic SPMD program -> per-rank concrete op lists."""
+    progs: List[List[COp]] = []
+    for r in range(n):
+        env = {"me": r, "n": n}
+        prog: List[COp] = []
+        rounds = 0
+        for op in ops:
+            if not all(bool(cap.ev(g, env)) for g in op.guards):
+                continue
+            f: Dict[str, Any] = {}
+            if op.kind == cap.PUT:
+                pe = int(cap.ev(op.fields["pe"], env)) % n
+                if pe == r:
+                    raise ValueError(
+                        f"rank {r}: put targets itself (pe={pe}) — use a "
+                        "local copy for the self segment")
+                f = dict(
+                    src=op.fields["src"].key(env),
+                    dst=op.fields["dst"].key(env),
+                    send_sem=op.fields["send_sem"].key(env),
+                    recv_sem=op.fields["recv_sem"].key(env),
+                    pe=pe,
+                )
+            elif op.kind == cap.COPY:
+                f = dict(src=op.fields["src"].key(env),
+                         dst=op.fields["dst"].key(env),
+                         sem=op.fields["sem"].key(env))
+            elif op.kind == cap.SIGNAL:
+                pe = op.fields["pe"]
+                pe = r if pe is None else int(cap.ev(pe, env)) % n
+                f = dict(sem=op.fields["sem"].key(env),
+                         amount=int(cap.ev(op.fields["amount"], env)),
+                         pe=pe)
+            elif op.kind in (cap.WAIT, cap.WAIT_SEND, cap.WAIT_RECV):
+                f = dict(sem=op.fields["sem"].key(env),
+                         amount=int(cap.ev(op.fields["amount"], env)))
+            elif op.kind == cap.BARRIER:
+                f = dict(round=rounds)
+                rounds += 1
+            elif op.kind in (cap.READ, cap.WRITE):
+                f = dict(slot=op.fields["slot"].key(env))
+            else:  # pragma: no cover - capture only emits the kinds above
+                raise ValueError(f"unknown op kind {op.kind}")
+            tag = {
+                k: (int(cap.ev(v, env)) if isinstance(v, cap.Sym) else v)
+                for k, v in op.tag.items()} if op.tag else None
+            prog.append(COp(op.kind, r, f, tag, op.sid, len(prog)))
+        progs.append(prog)
+    return progs
+
+
+@dataclasses.dataclass
+class _SlotInfo:
+    """Whole-program static facts about one (rank, sem, slot) counter."""
+
+    total: int = 0                      # sum of amounts ever produced
+    ranks: set = dataclasses.field(default_factory=set)
+    # (producer_rank, producer_pidx, amount) in program order — the
+    # FIFO attribution list when `ranks` is a singleton
+    order: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Execution:
+    n: int
+    graph: HBGraph
+    findings: List[Finding]
+    # (owner_rank, key) -> [("r"/"w", node, desc)]
+    accesses: Dict[tuple, List[tuple]]
+    # consumed-delivery attribution: one row per HB edge D -> wait:
+    # {receiver, sender, dst, put_tag, wait_tag}
+    delivery_edges: List[dict]
+    # (rank,) + sem key -> leftover produced-minus-consumed
+    leftover: Dict[tuple, int]
+
+
+def _slot_statics(progs: List[List[COp]]) -> Dict[tuple, _SlotInfo]:
+    info: Dict[tuple, _SlotInfo] = {}
+
+    def add(owner: int, key: tuple, producer: COp, amount: int):
+        s = info.setdefault((owner,) + key, _SlotInfo())
+        s.total += amount
+        s.ranks.add(producer.rank)
+        s.order.append((producer.rank, producer.pidx, amount))
+
+    for prog in progs:
+        for op in prog:
+            if op.kind == cap.PUT:
+                add(op.rank, op.f["send_sem"], op, 1)
+                add(op.f["pe"], op.f["recv_sem"], op, 1)
+            elif op.kind == cap.COPY:
+                add(op.rank, op.f["sem"], op, 1)
+            elif op.kind == cap.SIGNAL:
+                add(op.f["pe"], op.f["sem"], op, op.f["amount"])
+    for s in info.values():
+        s.order.sort(key=lambda t: (t[0], t[1]))
+    return info
+
+
+def execute(progs: List[List[COp]]) -> Execution:
+    """Greedy maximal run of all ranks; returns the HB graph + findings
+    from the execution itself (deadlock, leak). Race detection is a
+    separate pass over the finished graph (`check_races`)."""
+    n = len(progs)
+    g = HBGraph()
+    statics = _slot_statics(progs)
+    produced: Dict[tuple, int] = {}        # slot -> amount produced
+    consumed: Dict[tuple, int] = {}        # slot -> amount consumed
+    prod_nodes: Dict[tuple, list] = {}     # slot -> [(node, amount)]
+    wait_recs: List[tuple] = []            # (wnode, slot, cumulative, op)
+    accesses: Dict[tuple, List[tuple]] = {}
+    delivery: List[dict] = []
+    findings: List[Finding] = []
+    # put sid -> {(sender): ...} for delivery attribution rows
+    dmeta: Dict[int, dict] = {}
+
+    pc = [0] * n
+    last = [None] * n
+    barrier_round = [0] * n                # rounds completed per rank
+    joins: Dict[int, int] = {}             # round -> join node
+
+    def node(rank, label):
+        nd = g.add_node(label)
+        if last[rank] is not None:
+            g.add_edge(last[rank], nd)
+        last[rank] = nd
+        return nd
+
+    def access(kind, owner, key, nd, desc):
+        accesses.setdefault((owner,) + (key,), []).append((kind, nd, desc))
+
+    def produce(owner, key, amount, nd):
+        k = (owner,) + key
+        produced[k] = produced.get(k, 0) + amount
+        prod_nodes.setdefault(k, []).append((nd, amount))
+
+    def _wait_edges(op: COp, wnode: int):
+        """HB edges for the tokens a completed wait consumed — see the
+        module doc for the soundness rules."""
+        k = (op.rank,) + op.f["sem"]
+        info = statics.get(k)
+        c = consumed[k]
+        if info is None:
+            return
+        srcs: List[int] = []
+        if c >= info.total:
+            srcs = [nd for nd, _amt in prod_nodes.get(k, [])]
+        elif len(info.ranks) == 1:
+            # FIFO by the single producer rank's program order: the
+            # first k produces (cumulative >= c) must all have landed
+            need = c
+            for i, (_r, _p, amt) in enumerate(info.order):
+                if need <= 0:
+                    break
+                need -= amt
+                # producer i has executed (tokens exist), so its node
+                # is in prod_nodes — executed in program order
+                srcs.append(prod_nodes[k][i][0])
+        for s in srcs:
+            g.add_edge(s, wnode)
+            meta = dmeta.get(s)
+            if meta is not None:
+                delivery.append(dict(meta, receiver=op.rank,
+                                     wait_tag=op.tag))
+
+    def runnable(op: COp) -> bool:
+        if op.kind in (cap.WAIT, cap.WAIT_SEND, cap.WAIT_RECV):
+            k = (op.rank,) + op.f["sem"]
+            return (produced.get(k, 0) - consumed.get(k, 0)
+                    >= op.f["amount"])
+        if op.kind == cap.BARRIER:
+            rnd = op.f["round"]
+            for r2 in range(n):
+                if r2 == op.rank or barrier_round[r2] > rnd:
+                    continue
+                o2 = (progs[r2][pc[r2]] if pc[r2] < len(progs[r2])
+                      else None)
+                if not (o2 is not None and o2.kind == cap.BARRIER
+                        and o2.f["round"] == rnd):
+                    return False
+        return True
+
+    def run(op: COp):
+        r = op.rank
+        if op.kind == cap.PUT:
+            p = node(r, ("put", r, op.sid))
+            s_nd = g.add_node(("send_done", r, op.sid))
+            d_nd = g.add_node(("delivery", r, op.sid))
+            g.add_edge(p, s_nd)
+            g.add_edge(p, d_nd)
+            access("r", r, op.f["src"], s_nd,
+                   f"put src read of {op.f['src']}")
+            access("w", op.f["pe"], op.f["dst"], d_nd,
+                   f"delivery write of {op.f['dst']} from rank {r}")
+            produce(r, op.f["send_sem"], 1, s_nd)
+            produce(op.f["pe"], op.f["recv_sem"], 1, d_nd)
+            dmeta[d_nd] = dict(sender=r, dst=op.f["dst"], put_tag=op.tag)
+        elif op.kind == cap.COPY:
+            st = node(r, ("copy", r, op.sid))
+            c_nd = g.add_node(("copy_done", r, op.sid))
+            g.add_edge(st, c_nd)
+            access("r", r, op.f["src"], c_nd,
+                   f"copy read of {op.f['src']}")
+            access("w", r, op.f["dst"], c_nd,
+                   f"copy write of {op.f['dst']}")
+            produce(r, op.f["sem"], 1, c_nd)
+        elif op.kind == cap.SIGNAL:
+            nd = node(r, ("signal", r, op.sid))
+            produce(op.f["pe"], op.f["sem"], op.f["amount"], nd)
+        elif op.kind in (cap.WAIT, cap.WAIT_SEND, cap.WAIT_RECV):
+            k = (r,) + op.f["sem"]
+            consumed[k] = consumed.get(k, 0) + op.f["amount"]
+            nd = node(r, (op.kind, r, op.sid))
+            wait_recs.append((nd, k, consumed[k], op))
+            _wait_edges(op, nd)
+        elif op.kind == cap.BARRIER:
+            rnd = op.f["round"]
+            arrive = node(r, ("barrier_arrive", r, rnd))
+            if rnd not in joins:
+                joins[rnd] = g.add_node(("barrier_join", rnd))
+            g.add_edge(arrive, joins[rnd])
+            depart = node(r, ("barrier_depart", r, rnd))
+            g.add_edge(joins[rnd], depart)
+            barrier_round[r] = rnd + 1
+        elif op.kind == cap.READ:
+            nd = node(r, ("read", r, op.sid))
+            access("r", r, op.f["slot"], nd,
+                   f"read of {op.f['slot']}")
+        elif op.kind == cap.WRITE:
+            nd = node(r, ("write", r, op.sid))
+            access("w", r, op.f["slot"], nd,
+                   f"write of {op.f['slot']}")
+
+    progressed = True
+    while progressed:
+        progressed = False
+        for r in range(n):
+            while pc[r] < len(progs[r]) and runnable(progs[r][pc[r]]):
+                run(progs[r][pc[r]])
+                pc[r] += 1
+                progressed = True
+
+    stuck = [r for r in range(n) if pc[r] < len(progs[r])]
+    for r in stuck:
+        op = progs[r][pc[r]]
+        if op.kind == cap.BARRIER:
+            msg = (f"rank {r} blocked at barrier round "
+                   f"{op.f['round']} (team never fully arrives)")
+        else:
+            k = (r,) + op.f["sem"]
+            have = produced.get(k, 0) - consumed.get(k, 0)
+            msg = (f"rank {r} blocked on {op.kind} of sem "
+                   f"{op.f['sem']} (needs {op.f['amount']}, has {have}, "
+                   f"and no blocked rank can signal it; "
+                   f"op #{pc[r]} of {len(progs[r])})")
+        findings.append(Finding(DEADLOCK, msg))
+
+    if not stuck:
+        _refine_tally_edges(g, wait_recs, prod_nodes, dmeta, delivery)
+        leftover = {k: produced[k] - consumed.get(k, 0)
+                    for k in produced
+                    if produced[k] - consumed.get(k, 0) > 0}
+        for k, v in sorted(leftover.items()):
+            findings.append(Finding(
+                LEAK,
+                f"sem {k[1:]} on rank {k[0]} ends with {v} unconsumed "
+                f"signal(s) — signals/waits unbalanced (breaks "
+                "re-entrancy)"))
+    else:
+        leftover = {}
+
+    return Execution(n=n, graph=g, findings=findings, accesses=accesses,
+                     delivery_edges=delivery, leftover=leftover)
+
+
+def _refine_tally_edges(g, wait_recs, prod_nodes, dmeta, delivery):
+    """Fixpoint widening of the wait edges (module doc, rule 3): for a
+    wait W on slot k with cumulative consumption c, any producer that is
+    not provably AFTER W is a possible contributor; when the possible
+    contributors' amounts sum exactly to c, every one of them must have
+    fired before W — add the edges and iterate (new edges can shrink
+    other waits' contributor sets). Terminates: edges only grow."""
+    while True:
+        added = False
+        for wnode, k, cum, op in wait_recs:
+            prods = prod_nodes.get(k, [])
+            contrib = [(nd, amt) for nd, amt in prods
+                       if not g.reaches(wnode, nd)]
+            if not contrib or sum(a for _, a in contrib) != cum:
+                continue
+            for nd, _amt in contrib:
+                if nd == wnode or g.reaches(nd, wnode):
+                    continue
+                g.add_edge(nd, wnode)
+                meta = dmeta.get(nd)
+                if meta is not None:
+                    delivery.append(dict(meta, receiver=op.rank,
+                                         wait_tag=op.tag))
+                added = True
+        if not added:
+            return
+
+
+_MAX_RACE_REPORTS_PER_SLOT = 2
+
+
+def _regions_overlap(k1: tuple, k2: tuple) -> bool:
+    """Two slot keys of ONE buffer overlap when one is a prefix of the
+    other: equal keys are the same region, and a shorter key denotes the
+    containing region (`o.at()` is the whole buffer and overlaps every
+    `o.at(j)`; `o.at(1)` contains `o.at(1, c)`). Distinct same-length
+    indices are disjoint by construction (the model's partition)."""
+    shorter = min(len(k1), len(k2))
+    return k1[:shorter] == k2[:shorter]
+
+
+def check_races(ex: Execution) -> List[Finding]:
+    """Conflicting overlapping-region accesses on one (rank, buffer)
+    unordered by HB. Regions compare by prefix-containment
+    (`_regions_overlap`), so a protocol annotated at whole-buffer
+    granularity still conflicts with per-slot deliveries — mixed-arity
+    models fail safe instead of silently partitioning the buffer two
+    incomparable ways.
+
+    Skipped when the execution deadlocked — the HB graph of a stuck run
+    is partial and every diagnostic after the first would be noise."""
+    if any(f.klass == DEADLOCK for f in ex.findings):
+        return []
+    # group by (rank, buffer name); keys keep their full region tuple
+    by_buf: Dict[tuple, List[tuple]] = {}
+    for (owner, key), accs in ex.accesses.items():
+        grp = by_buf.setdefault((owner, key[0]), [])
+        for kind, nd, desc in accs:
+            grp.append((key, kind, nd, desc))
+    out: List[Finding] = []
+    for (owner, _name), accs in sorted(by_buf.items()):
+        reported = 0
+        for i, (key1, k1, n1, d1) in enumerate(accs):
+            for key2, k2, n2, d2 in accs[i + 1:]:
+                if k1 == "r" and k2 == "r":
+                    continue
+                if not _regions_overlap(key1, key2):
+                    continue
+                if ex.graph.ordered(n1, n2):
+                    continue
+                out.append(Finding(
+                    RACE,
+                    f"unordered conflicting accesses to {key1}/{key2} "
+                    f"on rank {owner}: [{d1}] vs [{d2}]"))
+                reported += 1
+                if reported >= _MAX_RACE_REPORTS_PER_SLOT:
+                    break
+            if reported >= _MAX_RACE_REPORTS_PER_SLOT:
+                break
+    return out
+
+
+def run_protocol(fn, n: int, **params) -> Execution:
+    """Capture fn(n, **params) symbolically, concretize at n, execute,
+    and attach the race findings. The one-stop entry the registry
+    runner and the cross-validation tests use."""
+    with cap.capturing(n) as c:
+        fn(n, **params)
+    progs = concretize(c.ops, n)
+    ex = execute(progs)
+    ex.findings.extend(check_races(ex))
+    return ex
+
+
+def check_protocol(fn, n: int, *, name: str = "?", **params) -> List[Finding]:
+    ex = run_protocol(fn, n, **params)
+    ptup = tuple(sorted(params.items()))
+    return [dataclasses.replace(f, kernel=name, n=n, params=ptup)
+            for f in ex.findings]

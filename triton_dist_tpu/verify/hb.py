@@ -1,0 +1,97 @@
+"""Happens-before graph: the shared reachability engine.
+
+One DAG implementation serves both halves of the correctness tooling:
+
+  - the protocol verifier (verify/engine.py) builds a node per executed
+    protocol event (program ops + DMA send-completion/delivery nodes)
+    with program-order, signal->satisfied-wait, and barrier-cut edges,
+    then asks `ordered` for every conflicting access pair;
+  - the megakernel scheduler's multi-core slot validator
+    (mega/scheduler._validate_slots_hb) builds a node per task with
+    queue program-order and scoreboard-watermark edges, then asks
+    `reaches` for every slot-sharing buffer pair.
+
+Edge semantics are "completion of a happens before start of b" —
+transitively closed because start <= completion on every node.
+Reachability is a reverse-topological bitset sweep (python ints as
+bitsets): O(V*E/64), plenty for protocol graphs of a few thousand nodes
+and task graphs of a few hundred.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class CycleError(ValueError):
+    """The graph is not a DAG — for the protocol verifier this means a
+    wait-for cycle (deadlock shape); for the scheduler, inconsistent
+    watermarks."""
+
+
+class HBGraph:
+    def __init__(self):
+        self._succ: List[List[int]] = []
+        self.labels: List[Any] = []
+        self._reach: Optional[List[int]] = None
+
+    def __len__(self) -> int:
+        return len(self._succ)
+
+    def add_node(self, label: Any = None) -> int:
+        self._succ.append([])
+        self.labels.append(label)
+        self._reach = None
+        return len(self._succ) - 1
+
+    def add_edge(self, a: int, b: int) -> None:
+        """completion(a) happens-before start(b)."""
+        if a == b:
+            raise CycleError(f"self-edge on node {a} ({self.labels[a]!r})")
+        self._succ[a].append(b)
+        self._reach = None
+
+    def succ(self, a: int) -> List[int]:
+        return self._succ[a]
+
+    def topo(self) -> List[int]:
+        n = len(self._succ)
+        indeg = [0] * n
+        for vs in self._succ:
+            for v in vs:
+                indeg[v] += 1
+        order = [u for u in range(n) if indeg[u] == 0]
+        head = 0
+        while head < len(order):
+            u = order[head]
+            head += 1
+            for v in self._succ[u]:
+                indeg[v] -= 1
+                if indeg[v] == 0:
+                    order.append(v)
+        if len(order) != n:
+            stuck = [u for u in range(n) if indeg[u] > 0]
+            raise CycleError(
+                f"cycle through nodes {stuck[:8]} "
+                f"({[self.labels[u] for u in stuck[:8]]!r})"
+            )
+        return order
+
+    def _closure(self) -> List[int]:
+        if self._reach is None:
+            reach = [0] * len(self._succ)
+            for u in reversed(self.topo()):
+                bits = 0
+                for v in self._succ[u]:
+                    bits |= (1 << v) | reach[v]
+                reach[u] = bits
+            self._reach = reach
+        return self._reach
+
+    def reaches(self, a: int, b: int) -> bool:
+        """True iff a strictly happens-before b (path of >= 1 edge)."""
+        return bool((self._closure()[a] >> b) & 1)
+
+    def ordered(self, a: int, b: int) -> bool:
+        """True iff a and b are ordered either way (or identical)."""
+        return a == b or self.reaches(a, b) or self.reaches(b, a)

@@ -1,0 +1,131 @@
+"""Protocol registry: every shipped collective registers its protocol
+model next to its kernel; the runner concretizes each at small team
+sizes and collects findings.
+
+A protocol model is a plain-python function `fn(n, **params)` that
+replays the kernel's cross-rank communication structure through the
+`lang/shmem.py` primitives (which record when a `verify.capturing()`
+block is active) plus the `verify` annotation helpers (local copies,
+raw ref reads/writes, rank guards). It lives IN the kernel module so
+protocol and kernel evolve together; registration at import time via
+`@registry.protocol(...)` keeps the harness free of per-kernel
+knowledge.
+
+Mutants (tests/_mutants.py) register through `@registry.mutant(...)`
+with the diagnostic class the verifier MUST emit for them; the CLI's
+`--mutants` mode fails unless every mutant is flagged with its class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from triton_dist_tpu.verify import engine
+
+DEFAULT_NS = (2, 4, 8)
+
+# kernel modules that register shipped protocol models at import time
+_PROTOCOL_MODULES = (
+    "triton_dist_tpu.kernels.all_to_all",
+    "triton_dist_tpu.kernels.ep_a2a",
+    "triton_dist_tpu.kernels.allgather",
+    "triton_dist_tpu.kernels.allgather_gemm",
+    "triton_dist_tpu.kernels.reduce_scatter",
+    "triton_dist_tpu.kernels.gemm_reduce_scatter",
+    "triton_dist_tpu.kernels.allreduce",
+    "triton_dist_tpu.kernels.low_latency_allgather",
+    "triton_dist_tpu.kernels.p2p",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    name: str
+    fn: Callable
+    ns: Tuple[int, ...]
+    grid: Tuple[dict, ...]          # param dicts; fn(n, **params) each
+    expect: Optional[str] = None    # mutants: required diagnostic class
+    doc: str = ""
+
+
+_SHIPPED: Dict[str, ProtocolSpec] = {}
+_MUTANTS: Dict[str, ProtocolSpec] = {}
+
+
+def protocol(name: str, ns: Tuple[int, ...] = DEFAULT_NS,
+             grid: Tuple[dict, ...] = ({},), doc: str = ""):
+    """Register a shipped kernel's protocol model (import-time
+    decorator in the kernel module)."""
+
+    def deco(fn):
+        if name in _SHIPPED and _SHIPPED[name].fn is not fn:
+            raise ValueError(f"duplicate protocol registration {name!r}")
+        _SHIPPED[name] = ProtocolSpec(name, fn, tuple(ns), tuple(grid),
+                                      doc=doc)
+        return fn
+
+    return deco
+
+
+def mutant(name: str, expect: str, ns: Tuple[int, ...] = (4,),
+           grid: Tuple[dict, ...] = ({},), doc: str = ""):
+    """Register a deliberately broken protocol with the diagnostic
+    class the verifier must flag it with."""
+    if expect not in engine.CLASSES:
+        raise ValueError(f"unknown diagnostic class {expect!r} "
+                         f"(one of {engine.CLASSES})")
+
+    def deco(fn):
+        _MUTANTS[name] = ProtocolSpec(name, fn, tuple(ns), tuple(grid),
+                                      expect=expect, doc=doc)
+        return fn
+
+    return deco
+
+
+def load_shipped() -> Dict[str, ProtocolSpec]:
+    """Import every kernel module that carries a protocol model and
+    return the registry (idempotent)."""
+    for m in _PROTOCOL_MODULES:
+        importlib.import_module(m)
+    return dict(_SHIPPED)
+
+
+def shipped() -> Dict[str, ProtocolSpec]:
+    return dict(_SHIPPED)
+
+
+def mutants() -> Dict[str, ProtocolSpec]:
+    """The mutant registry (populated by importing tests/_mutants.py —
+    the corpus lives with the tests, not the package)."""
+    return dict(_MUTANTS)
+
+
+def verify_spec(spec: ProtocolSpec) -> List[engine.Finding]:
+    """All findings for one registered protocol across its team sizes
+    and parameter grid."""
+    out: List[engine.Finding] = []
+    for n in spec.ns:
+        for params in spec.grid:
+            out.extend(engine.check_protocol(
+                spec.fn, n, name=spec.name, **params))
+    return out
+
+
+def verify_shipped(names=None) -> List[engine.Finding]:
+    """Run the verifier over every shipped collective's protocol model
+    (the `scripts/verify_kernels.py` core). Empty list == all proven
+    deadlock-free / race-free / balanced at the checked team sizes."""
+    reg = load_shipped()
+    if names:
+        missing = sorted(set(names) - set(reg))
+        if missing:
+            raise KeyError(f"unknown protocol(s) {missing}; "
+                           f"registered: {sorted(reg)}")
+        reg = {k: reg[k] for k in names}
+    out: List[engine.Finding] = []
+    for name in sorted(reg):
+        out.extend(verify_spec(reg[name]))
+    return out
