@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Lint numeric perf claims against the artifact of record.
+
+Three rounds running, the AG+GEMM docstring claimed "0.98-1.00x of XLA"
+while the driver-captured `pallas_vs_xla` printed 1.10 — prose drifts,
+the artifact does not. This linter makes such drift a nonzero exit:
+
+1. Every perf claim in kernel docstrings / docs is written in the
+   lintable bracket form `[perf:KEY=LO-HI]` (KEY a bench.py schema key,
+   LO-HI the claimed inclusive band; `[perf:KEY=V]` claims the exact
+   value within FLOAT_TOL). Freeform "0.98x of XLA" prose is decoration;
+   the bracket is the claim.
+2. Each claim KEY must exist in bench.py's result schema
+   (_NUMERIC_KEYS) — a renamed or typo'd metric fails here, so a claim
+   can never silently detach from the measurement.
+3. Claims are checked against the measured value per key — the newest
+   BENCH_r*.json carrying that key wins (so a round whose arm errored
+   falls back to the last round that measured it), then
+   BASELINE.json["published"]. Measured outside the claimed band =
+   contradiction = exit 1. No artifact at all skips only this step.
+4. REQUIRED_CLAIMS pins where the load-bearing claims must live:
+   deleting the AG+GEMM parity sentence (instead of correcting it) is
+   itself a failure, and so is a required claim no artifact backs.
+
+Exit codes (CI contract; wired into __graft_entry__'s dryrun plane next
+to verify_kernels.py):
+
+  0  all claims present, schema-valid, and consistent with the artifact
+  1  contradiction, unknown schema key, or missing required claim
+  2  usage error
+
+Pure file I/O + an ast read of bench.py's schema literal — no jax, no
+package import; runs anywhere in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# [perf:key=lo-hi] or [perf:key=value]; a markdown/docstring-safe token
+CLAIM_RE = re.compile(
+    r"\[perf:([A-Za-z0-9_]+)=([0-9]*\.?[0-9]+)(?:-([0-9]*\.?[0-9]+))?\]"
+)
+
+# files scanned for claims (repo-relative globs)
+SCAN_GLOBS = (
+    "triton_dist_tpu/**/*.py",
+    "docs/*.md",
+    "bench.py",
+)
+
+# (key, repo-relative file) pairs that MUST carry a claim: the
+# historically drifting ones. Removing the sentence is as loud as
+# contradicting it.
+REQUIRED_CLAIMS = (
+    ("pallas_vs_xla", "triton_dist_tpu/kernels/allgather_gemm.py"),
+    ("pallas_vs_xla", "docs/performance.md"),
+    ("gemm_rs_vs_xla", "triton_dist_tpu/kernels/gemm_reduce_scatter.py"),
+    ("gemm_rs_vs_xla", "docs/performance.md"),
+)
+
+FLOAT_TOL = 0.005  # slack for exact-value claims (rounding in the JSON)
+
+
+def _bench_numeric_keys(repo: str):
+    """The _NUMERIC_KEYS set literal, read via ast — importing bench.py
+    would drag in jax + the whole package for a pure text lint (this
+    CLI must run anywhere in milliseconds, like scripts/lint.py)."""
+    import ast
+
+    with open(os.path.join(repo, "bench.py"), encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "_NUMERIC_KEYS"
+                        for t in node.targets)):
+            return set(ast.literal_eval(node.value))
+    return None  # caller reports: schema check impossible
+
+
+def collect_claims(repo: str):
+    """[(relpath, key, lo, hi)] over every scanned file."""
+    out = []
+    for pattern in SCAN_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo, pattern),
+                                     recursive=True)):
+            rel = os.path.relpath(path, repo)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for m in CLAIM_RE.finditer(text):
+                key, lo = m.group(1), float(m.group(2))
+                hi = float(m.group(3)) if m.group(3) else None
+                if hi is None:
+                    lo, hi = lo - FLOAT_TOL, lo + FLOAT_TOL
+                out.append((rel, key, lo, hi))
+    return out
+
+
+def latest_measured(repo: str):
+    """(label, {key: (value, source_label)}) over BENCH_r*.json newest
+    first, then BASELINE.json["published"]. Per KEY the newest artifact
+    carrying it wins — a round whose arm errored (key absent) falls
+    back to the last round that measured it, so a claim never silently
+    detaches from measurement just because the newest run dropped the
+    field. Returns (None, {}) when no artifact exists at all."""
+    sources = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            sources.append((os.path.basename(path), parsed))
+    base = os.path.join(repo, "BASELINE.json")
+    try:
+        with open(base) as f:
+            pub = json.load(f).get("published", {})
+        if isinstance(pub, dict) and pub:
+            sources.append(("BASELINE.json", pub))
+    except (OSError, ValueError):
+        pass
+    measured = {}
+    for label, flat in sources:
+        for k, v in flat.items():
+            if (k not in measured and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                measured[k] = (v, label)
+    return (sources[0][0] if sources else None), measured
+
+
+def check(repo: str = _REPO, verbose: bool = False) -> int:
+    claims = collect_claims(repo)
+    schema = _bench_numeric_keys(repo)
+    problems = []
+
+    if schema is None:
+        problems.append("bench.py: could not locate the _NUMERIC_KEYS "
+                        "set literal — schema check impossible")
+        schema = set()
+
+    for key, rel in REQUIRED_CLAIMS:
+        if not any(c[0] == rel and c[1] == key for c in claims):
+            problems.append(
+                f"{rel}: required [perf:{key}=...] claim is MISSING "
+                "(correct the claim, don't delete it)")
+
+    for rel, key, lo, hi in claims:
+        if key not in schema:
+            problems.append(
+                f"{rel}: claim key {key!r} is not in bench.py's result "
+                "schema (_NUMERIC_KEYS) — typo or stale rename")
+
+    label, measured = latest_measured(repo)
+    required_keys = {k for k, _ in REQUIRED_CLAIMS}
+    if label is None:
+        print("check_perf_claims: no BENCH_r*.json / published baseline "
+              "— schema + presence checks only", file=sys.stderr)
+    for rel, key, lo, hi in claims:
+        got, src = measured.get(key, (None, None))
+        status = "unmeasured"
+        if got is not None:
+            ok = lo <= got <= hi
+            status = f"measured {got} [{src}] " \
+                     f"({'ok' if ok else 'CONTRADICTED'})"
+            if not ok:
+                problems.append(
+                    f"{rel}: claims {key} in [{lo}, {hi}] but {src} "
+                    f"measured {got}")
+        elif label is not None and key in required_keys:
+            # fail CLOSED: a load-bearing claim no artifact (current or
+            # prior) backs is exactly the silent detachment this tool
+            # exists to prevent
+            problems.append(
+                f"{rel}: required claim {key!r} is not measured by ANY "
+                "bench artifact — the claim is unbacked")
+        if verbose:
+            print(f"{rel}: [perf:{key}={lo}-{hi}] {status}")
+
+    for p in problems:
+        print(f"check_perf_claims: {p}", file=sys.stderr)
+    n = len(claims)
+    print(f"check_perf_claims: {n} claim(s) vs {label or '<none>'}, "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    return check(verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,22 +117,38 @@ def test_prune_fallback_when_nothing_fits_returns_single_smallest():
 
 
 def test_prune_gemm_rs_local_configs_respects_vmem():
+    """Default prune budget is the chip's forced-kernel VMEM ceiling
+    (perf_model.kernel_vmem_ceiling — the kernels grant forced
+    candidates the VMEM their tiling implies, so the conservative
+    auto-fallback dataclass budget must not cut the measured frontier);
+    an explicit vmem_budget still prunes exactly."""
     from triton_dist_tpu.autotuner import prune_gemm_rs_local_configs
     from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
     from triton_dist_tpu.lang.core import fit_tile
 
     chip = pm.CHIPS["TPU v5 lite"]
     m, k_loc, n_full = 2048, 3200, 5120
-    budget = GemmRsConfig().vmem_budget
-    for c in prune_gemm_rs_local_configs(m, k_loc, n_full, chip=chip):
+
+    def need(c):
         tm = fit_tile(c.tile_m_local, m)
         tn = fit_tile(c.tile_n_local, n_full)
         tk = fit_tile(c.tile_k_local, k_loc)
         nk = -(-k_loc // tk)
-        need = 2 * (tm * tk + tk * tn) * 2 + 2 * tm * tn * 2
-        if nk > 1:
-            need += tm * tn * 4
-        assert need <= budget, (c, need)
+        return (2 * (tm * tk + tk * tn) * 2 + 2 * tm * tn * 2
+                + (tm * tn * 4 if nk > 1 else 0))
+
+    ceiling = pm.kernel_vmem_ceiling(chip)
+    default = prune_gemm_rs_local_configs(m, k_loc, n_full, chip=chip)
+    for c in default:
+        assert need(c) <= ceiling, (c, need(c))
+    # the widened default frontier reaches past the old fallback budget
+    # (that was the mis-pruning: the roofline winners need > 14 MiB)
+    assert any(need(c) > GemmRsConfig().vmem_budget for c in default)
+    # explicit budgets are still binding
+    tight = GemmRsConfig().vmem_budget
+    for c in prune_gemm_rs_local_configs(m, k_loc, n_full, chip=chip,
+                                         vmem_budget=tight):
+        assert need(c) <= tight, (c, need(c))
 
 
 # -- chunk-pipelined EP MoE model (ISSUE 2 tentpole (c)) ---------------------

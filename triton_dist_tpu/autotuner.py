@@ -217,17 +217,22 @@ def ag_gemm_config_space():
     """Candidate AgGemmConfig grid for the contextual tuner (the reference
     folds these into its context factories; ours ship a measured default
     and let `autotune` override per shape). The wide-N rows (tn >= 1280,
-    up to the full 3200-column Qwen3-32B gate width) are where the
-    round-5 sweep found the winners — per-grid-step overhead dominates at
-    the benched shapes, so fewer/wider tiles beat traffic-optimal ones;
-    tk spanning to 5120 covers the nk==1 direct-store regime (no f32
-    accumulator round-trip, see _ag_gemm_kernel)."""
+    up to the FULL 6400-column gate|up width) are where the round-5
+    sweep found the winners — per-grid-step overhead dominates at the
+    benched shapes, so fewer/wider tiles beat traffic-optimal ones; tk
+    spanning to 5120 covers the nk==1 direct-store regime (no f32
+    accumulator round-trip, see _ag_gemm_kernel). tm=2048 (mt=1) rows
+    cut the dominant B-re-read term (one pass per row-tile sweep) and
+    only became measurable once the prune budget moved to the chip VMEM
+    ceiling (perf_model.kernel_vmem_ceiling) — the 15 MiB fallback
+    budget was pruning the frontier exactly where the roofline puts the
+    winners (the world=1 tax push)."""
     from triton_dist_tpu.kernels.allgather_gemm import AgGemmConfig
 
     return [
         AgGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk)
         for tm in (256, 512, 1024, 2048)
-        for tn in (256, 640, 1024, 1280, 3200)
+        for tn in (256, 640, 1024, 1280, 3200, 6400)
         for tk in (512, 1024, 2048, 5120)
     ]
 
@@ -242,13 +247,16 @@ def gemm_rs_local_config_space():
     """Candidate local-regime (world=1 forced / blocked-matmul) tiles for
     gemm_rs — the benched Qwen3-32B down-proj path. tile_k_local=3200
     hits the nk==1 regime at the bench K (direct store, no accumulator
-    read-modify-write)."""
+    read-modify-write); tm=2048 / tn=5120 rows reach the few-grid-step
+    corner (e.g. (1024, 2560, 3200) is a 4-step direct-store sweep at
+    the bench shape) that the old 14 MiB prune budget excluded — see
+    ag_gemm_config_space on the kernel_vmem_ceiling change."""
     from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
 
     return [
         GemmRsConfig(tile_m_local=tm, tile_n_local=tn, tile_k_local=tk)
-        for tm in (256, 512, 1024)
-        for tn in (640, 1280, 2560)
+        for tm in (256, 512, 1024, 2048)
+        for tn in (640, 1280, 2560, 5120)
         for tk in (640, 1024, 1600, 3200)
     ]
 
@@ -268,7 +276,16 @@ def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
     kernels double-buffer each block operand, keep a 2-deep output
     window, and carry an f32 accumulator only when the K sweep is tiled
     (nk > 1; nk == 1 is the direct-store regime) — so a config is never
-    measured in a degraded form the model did not score."""
+    measured in a degraded form the model did not score.
+
+    The default budget is the CHIP's forced-kernel ceiling
+    (perf_model.kernel_vmem_ceiling), not the config dataclass's
+    conservative auto-fallback figure: forced/tuned candidates get
+    vmem_limit_bytes = what their tiling implies (both kernels grant
+    it), so pruning at the 14-15 MiB fallback budget was cutting the
+    frontier exactly where the roofline says the winners live — the
+    wide-tm few-step sweeps and the nk==1 direct-store tiles need
+    30-63 MiB of a v5e's 128."""
     import jax.numpy as jnp
 
     from triton_dist_tpu.lang.core import fit_tile
@@ -277,10 +294,13 @@ def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
         roofline_frontier,
     )
 
+    from triton_dist_tpu.perf_model import kernel_vmem_ceiling
+
     dtype = dtype or jnp.bfloat16
     isz = jnp.dtype(dtype).itemsize
     osz = jnp.dtype(out_dtype or dtype).itemsize
-    budget = vmem_budget or default_budget
+    budget = vmem_budget or max(default_budget,
+                                kernel_vmem_ceiling(chip))
     am, an, ak = attr_names
 
     def fitted(cfg):

@@ -33,6 +33,14 @@ regimes, chosen by VMEM fit.
   hides under the matmul. (The alternative — one ring per N tile so b
   streams once — trades it for nt x smaller, latency-exposed hops; not
   implemented.)
+
+world=1 tax, per the artifact of record (driver-captured bench.py): the
+forced local blocked-matmul regime at the 32B down-proj shape measured
+1.07-1.10x XLA's dot across rounds 4-5 [perf:gemm_rs_vs_xla=0.90-1.12].
+The round-6 candidate search reaches the few-grid-step nk==1
+direct-store corner (e.g. (1024, 2560, 3200) — a 4-step sweep) the old
+14 MiB prune budget excluded. scripts/check_perf_claims.py lints the
+bracketed claim against the latest driver artifact.
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ the dependency is the whole buffer).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Hashable, List, Sequence, Tuple
 
 
@@ -36,6 +37,57 @@ def fit_mm_tile(n: int, cap: int = 512) -> int:
             if best == 1:
                 best = t
     return best
+
+
+# Weight-tile VMEM byte budget (per tile slot, bf16 accounting). The
+# round-5 32B ledger attributed the 1.18x gap-to-floor largely to SHORT
+# DMA BURSTS: a (K, TN) slice of an HBM-resident (L, K, N) weight is
+# strided — each contiguous run is only TN * itemsize bytes — and at the
+# legacy 512-column cap the Qwen3-32B gate_up tile streamed in 512-byte
+# bursts (~0.85 of peak HBM on the burst-efficiency model,
+# perf_model.hbm_stream_efficiency). A byte budget instead of a fixed
+# column cap lets big-K weights take the widest dividing lane-multiple
+# column count whose tile still fits the budget: at 16 MiB the 32B shard
+# tiles at TN=1280 (2.5 KiB bursts), the 8B at TN<=2048. The budget is
+# per SLOT; the kernel keeps 2 own-tile slots plus the prefetch arena,
+# so total weight VMEM is ~(2 + pf_depth) x this figure.
+MM_TILE_BYTES_DEFAULT = 16 << 20
+
+
+def mm_tile_bytes() -> int:
+    """TDT_MEGA_TILE_BYTES override (bytes), else the 16 MiB default."""
+    return int(os.environ.get("TDT_MEGA_TILE_BYTES",
+                              str(MM_TILE_BYTES_DEFAULT)))
+
+
+def mm_tile_cap(kmax: int, itemsize: int = 2) -> int:
+    """Column cap implied by the tile byte budget at contract dim kmax,
+    rounded down to a lane multiple; never below the legacy 512 (small
+    graphs keep their historical tiling). itemsize is fixed at the bf16
+    2 by callers so the scheduler (which has no dtype) and the kernel
+    always derive the SAME cap — the budget is a sizing heuristic, not
+    an exact VMEM bound (compile_graph computes the real vmem limit
+    from the fitted tiles)."""
+    cap = (mm_tile_bytes() // (max(kmax, 1) * itemsize)) // 128 * 128
+    return max(512, cap)
+
+
+def plan_mm_tiles(mm_keys: Sequence[Hashable]) -> Dict[Hashable, int]:
+    """branch_key -> TN for every matmul branch key, under ONE
+    byte-budgeted column cap derived from the graph's largest contract
+    dim (the own-tile buffer and prefetch arena are shared rectangles of
+    (max K, max TN), so the cap must be global, not per-weight).
+
+    THE single tiling definition: kernel._matmul_branch, the prefetch
+    planner (scheduler.prefetch_specs), and the byte-accurate floor
+    model (perf_model.mega_decode_traffic_terms) all tile through this
+    map; a divergence would break the prefetch-coverage invariant or
+    make the floor model explain the wrong bytes."""
+    keys = [k for k in mm_keys if k and k[0] == "matmul"]
+    if not keys:
+        return {}
+    cap = mm_tile_cap(max(k[2] for k in keys))
+    return {k: fit_mm_tile(k[3], cap) for k in set(keys)}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -68,10 +68,9 @@ from triton_dist_tpu.lang.core import (
     round_up,
     tpu_call,
 )
-from triton_dist_tpu.mega.core import Graph, fit_mm_tile
+from triton_dist_tpu.mega.core import Graph, plan_mm_tiles
 from triton_dist_tpu.mega.scheduler import (
     Schedule,
-    default_pf_depth,
     monotone_watermarks,
     plan_prefetch,
     plan_store_forward,
@@ -121,9 +120,17 @@ def physical_core_count():
     return None
 
 
-# single tiling definition shared with the scheduler's prefetch planner
-# (mega/core.fit_mm_tile): both sides must agree on each matmul's (K, TN)
-_fit_tile = fit_mm_tile
+def tile_weight_major(w, tn: int):
+    """Re-lay a stacked weight (..., K, N) as tile-major
+    (..., N//tn, K, tn): block [..., j] is then K*tn*itemsize fully
+    CONTIGUOUS bytes in HBM, so its DMA streams at peak bandwidth
+    instead of N-strided tn-wide bursts. Done ONCE at init (a
+    materializing transpose — never per step); the kernel reads tiled
+    weights via compile_graph(tiled_weights=...)."""
+    *lead, k, n = w.shape
+    nt = n // tn
+    assert nt * tn == n, f"N={n} not divisible by tile {tn}"
+    return jnp.moveaxis(w.reshape(*lead, k, nt, tn), -2, -3)
 
 
 @dataclasses.dataclass
@@ -155,6 +162,12 @@ class _Env:
     pfsem: Any = None
     pf_specs: Any = None  # [(wname, K, TN)] in weight-name order
     pf_depth: int = 1     # rotating prefetch-arena slots
+    # byte-budgeted matmul tile map (mega/core.plan_mm_tiles): branch
+    # key -> TN; the scheduler's prefetch plan is built on the same map
+    mm_tn: Dict = dataclasses.field(default_factory=dict)
+    # weight names stored tile-major (L, nt, K, TN): block [layer, j]
+    # is contiguous in HBM (see tile_weight_major)
+    tiled: frozenset = frozenset()
     store_widths: Any = ()  # static store-width table (pend_w indexes it)
     chsem: Any = None       # scratch sem for the interpret-mode AR churn
     mailbox: Any = None
@@ -189,6 +202,16 @@ def _silu_f32(g, u):
 # -- branch builders (one per op kind; key carries the static config) --------
 
 
+def _w_tile_src(env: _Env, wname: str, layer, j, K: int, TN: int):
+    """The (K, TN) HBM source of weight tile j: tile-major weights
+    index a contiguous block, plain (L, K, N) weights a strided column
+    slice. ONE definition for own-tile loads and prefetch issues — the
+    layouts must never diverge between the two."""
+    if wname in env.tiled:
+        return env.weights[wname].at[layer, j]
+    return env.weights[wname].at[layer, :, pl.ds(j * TN, TN)]
+
+
 def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int, slot):
     """THE prefetch descriptor: start (issuer) and wait (consumer) must
     reconstruct it identically for the semaphore accounting to balance —
@@ -196,7 +219,7 @@ def _pf_copy(env: _Env, wname: str, layer, K: int, TN: int, slot):
     slot (and its per-slot semaphore), so up to pf_depth first tiles can
     be in flight across task boundaries."""
     return pltpu.make_async_copy(
-        env.weights[wname].at[layer, :, pl.ds(0, TN)],
+        _w_tile_src(env, wname, layer, 0, K, TN),
         env.vpf.at[slot, pl.ds(0, K), pl.ds(0, TN)],
         env.pfsem.at[slot],
     )
@@ -270,9 +293,8 @@ def _matmul_branch(key, env: _Env):
     prologue: None · "rms" (input rms-norm, per-task norm row in a3) ·
     "silu" (input is [gate|up] of width 2K; a = silu(gate) * up)."""
     _, wname, K, N, prologue, eps = key
-    TN = _fit_tile(N)
+    TN = env.mm_tn[key]  # byte-budgeted tile map (core.plan_mm_tiles)
     nt = N // TN
-    w_ref = env.weights[wname]
     in_w = 2 * K if prologue == "silu" else K
     pf_eligible = any(w == wname and kk == K and tn == TN
                       for w, kk, tn in env.pf_specs)
@@ -280,7 +302,7 @@ def _matmul_branch(key, env: _Env):
 
     def wcopy(layer, j, slot):
         return pltpu.make_async_copy(
-            w_ref.at[layer, :, pl.ds(j * TN, TN)],
+            _w_tile_src(env, wname, layer, j, K, TN),
             env.vw.at[slot, pl.ds(0, K), pl.ds(0, TN)],
             env.wsems.at[slot],
         )
@@ -935,12 +957,24 @@ class CompiledMega:
     norm_width: int  # required minor dim of the stacked norms array
     branch_keys: List[Any]
     weight_names: List[str]
+    # byte-budgeted matmul tile map (branch key -> TN) and the weight
+    # names `run` expects in tile-major (L, nt, K, TN) layout
+    mm_tiles: Dict[Any, int] = dataclasses.field(default_factory=dict)
+    tiled_weights: tuple = ()
 
     def workspace(self, dtype) -> jnp.ndarray:
         return jnp.zeros((self.n_slots * self.pb, self.wmax), dtype)
 
     def slot_rows(self, buf_slot: int):
         return slice(buf_slot * self.pb, buf_slot * self.pb + self.pb)
+
+    def tile_cols(self, wname: str) -> int:
+        """TN of weight `wname` (every matmul using a weight must agree
+        on one tile for it to be addressable here — same uniqueness rule
+        as prefetchability)."""
+        tns = {tn for k, tn in self.mm_tiles.items() if k[1] == wname}
+        assert len(tns) == 1, f"{wname}: non-unique tile set {tns}"
+        return tns.pop()
 
 
 def compile_graph(
@@ -949,6 +983,7 @@ def compile_graph(
     dtype,
     name: str = "megakernel",
     straggler: tuple = (-1, 0),
+    tiled_weights: tuple = (),
 ) -> CompiledMega:
     """Lower (graph, schedule) to one pallas_call (the reference's
     ModelBuilder.compile, model_builder.py:372-389: codegen + jit). The
@@ -986,12 +1021,29 @@ def compile_graph(
     # weight-streaming plan (scheduler.plan_prefetch): pf_specs is the
     # arena geometry, the per-task issue/consume arrays fill row columns
     # 7-10. Schedules produced by schedule_graph carry the plan; bare
-    # Schedules (tests) get one planned here.
+    # Schedules (tests) get one planned here (byte-aware auto depth).
     pf_plan = sched.prefetch
     if pf_plan is None:
-        pf_plan = plan_prefetch(graph, sched, depth=default_pf_depth())
+        pf_plan = plan_prefetch(graph, sched)
     pf_specs = pf_plan.specs
     pf_depth = pf_plan.depth
+
+    # byte-budgeted matmul tile map — MUST be the map the prefetch plan
+    # was built on (both call core.plan_mm_tiles; the assert catches an
+    # env-var flip between scheduling and compiling)
+    mm_tiles = plan_mm_tiles([k for k in {t.branch_key for t in tasks}
+                              if k[0] == "matmul"])
+    for wname, kk, tn in pf_specs:
+        got = {mm_tiles[k] for k in mm_tiles if k[1] == wname}
+        assert got == {tn}, (
+            f"prefetch plan tiles {wname} at {tn} but the kernel would "
+            f"tile it at {got} — TDT_MEGA_TILE_BYTES changed between "
+            "schedule_graph and compile_graph")
+    tiled_weights = tuple(tiled_weights)
+    mm_names = {k[1] for k in mm_tiles}
+    assert set(tiled_weights) <= mm_names, (
+        f"tiled_weights {tiled_weights} not all matmul weights "
+        f"({sorted(mm_names)})")
 
     # store/forward plan (single-core only; see scheduler.StorePlan).
     # Per-branch capabilities live here because only the kernel knows
@@ -1092,7 +1144,7 @@ def compile_graph(
                        + 2 * round_up(k[2] * k[3], 128))
     mm_keys = [k for k in branch_keys if k[0] == "matmul"]
     kmax = max((k[2] for k in mm_keys), default=128)
-    tnmax = max((_fit_tile(k[3]) for k in mm_keys), default=128)
+    tnmax = max((mm_tiles[k] for k in mm_keys), default=128)
     at_keys = [k for k in branch_keys if k[0] == "attention"]
     assert len({k[1:] for k in at_keys}) <= 1, (
         "one attention geometry per megakernel graph"
@@ -1163,6 +1215,7 @@ def compile_graph(
             v_cache=v_cache, vin=vin, vin2=vin2, vout=vout, vw=vw,
             vkv=vkv, vrope=vrope, vnq=vnq, vnk=vnk, vpf=vpf,
             pfsem=pfsem, pf_specs=pf_specs, pf_depth=pf_depth,
+            mm_tn=mm_tiles, tiled=frozenset(tiled_weights),
             store_widths=store_widths, chsem=chsem, mailbox=mailbox,
             ld1=ld1, ld2=ld2,
             st=st, wsems=wsems, kvsem=kvsem, kvsems=kvsems, send=send,
@@ -1356,5 +1409,6 @@ def compile_graph(
     return CompiledMega(
         run=run, queue=queue, n_slots=n_slots, pb=PB, wmax=wmax,
         norm_width=norm_width, branch_keys=branch_keys,
-        weight_names=weight_names,
+        weight_names=weight_names, mm_tiles=mm_tiles,
+        tiled_weights=tiled_weights,
     )

@@ -242,19 +242,6 @@ class MegaQwen3:
         )
         dt = jnp.dtype(cfg.dtype)
         self.dtype = dt
-        # fuse gate|up ONCE at init for one-DMA weight streaming in the
-        # kernel (params store them split so XLA can fuse the silu
-        # epilogue in the eager paths; see models/dense.py), then strip
-        # the split copies from the pytree this model's jit consumes —
-        # the kernel never reads them, and for a standalone MegaQwen3
-        # (no Engine sharing the params) stripping frees their HBM.
-        self._w_gate_up = jax.jit(
-            lambda g, u: jnp.concatenate([g, u], axis=-1),
-            out_shardings=NamedSharding(mesh, P(None, axis)),
-        )(self.params.layers.w_gate, self.params.layers.w_up)
-        self.params = self.params._replace(
-            layers=self.params.layers._replace(w_gate=None, w_up=None)
-        )
 
         from triton_dist_tpu.mega.kernel import _kv_chunk
 
@@ -283,9 +270,34 @@ class MegaQwen3:
         self._trace_build = active_build()
         self.cm: CompiledMega = compile_graph(
             self.graph, sched, dt, name=f"mega_qwen3_{axis}{n}",
-            straggler=straggler,
+            straggler=straggler, tiled_weights=("w_gate_up",),
         )
         self._meta = meta
+
+        # fuse gate|up ONCE at init for one-DMA weight streaming in the
+        # kernel (params store them split so XLA can fuse the silu
+        # epilogue in the eager paths; see models/dense.py) — and lay
+        # the fused copy out TILE-MAJOR (L, n, nt, H, TN): this weight
+        # is >half the 32B shard's streamed bytes and the copy is being
+        # materialized anyway, so re-blocking it is free HBM-wise and
+        # turns its per-tile DMA from N-strided TN*2-byte bursts into
+        # one fully contiguous K*TN*2-byte block (the round-5 ledger's
+        # biggest single burst-efficiency lever; kernel.
+        # tile_weight_major). The split copies are then stripped from
+        # the pytree this model's jit consumes — the kernel never reads
+        # them, and for a standalone MegaQwen3 (no Engine sharing the
+        # params) stripping frees their HBM.
+        from triton_dist_tpu.mega.kernel import tile_weight_major
+
+        gu_tn = self.cm.tile_cols("w_gate_up")
+        self._w_gate_up = jax.jit(
+            lambda g, u: tile_weight_major(
+                jnp.concatenate([g, u], axis=-1), gu_tn),
+            out_shardings=NamedSharding(mesh, P(None, axis)),
+        )(self.params.layers.w_gate, self.params.layers.w_up)
+        self.params = self.params._replace(
+            layers=self.params.layers._replace(w_gate=None, w_up=None)
+        )
 
         L = cfg.num_layers
         NW = self.cm.norm_width

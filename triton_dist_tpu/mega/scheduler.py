@@ -29,16 +29,34 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from triton_dist_tpu.mega import _native
-from triton_dist_tpu.mega.core import Graph, fit_mm_tile
+from triton_dist_tpu.mega.core import Graph, plan_mm_tiles
 
 STRATEGIES = {"round_robin": 0, "blocked": 1, "least_loaded": 2}
 
 
-def default_pf_depth() -> int:
-    """Weight-prefetch arena depth (rotating VMEM slots). 2 keeps one
-    tile in flight across every task boundary; TDT_MEGA_PF_DEPTH
-    overrides (1 restores the legacy single-tile lookahead)."""
-    return max(1, int(os.environ.get("TDT_MEGA_PF_DEPTH", "2")))
+def pf_arena_bytes() -> int:
+    """Prefetch-arena VMEM byte budget (TDT_MEGA_PF_ARENA_BYTES,
+    default 32 MiB — two 32B-class first tiles in flight)."""
+    return int(os.environ.get("TDT_MEGA_PF_ARENA_BYTES", str(32 << 20)))
+
+
+def auto_pf_depth(specs) -> int:
+    """Byte-aware arena depth: as many rotating slots as the byte
+    budget buys at this graph's arena-rectangle size (the arena is one
+    (depth, max K, max TN) VMEM block — the RECTANGLE is what occupies
+    VMEM, not the per-weight tile), clamped to [2, 4]. The floor of 2
+    keeps one tile in flight across every task boundary (depth 1 is
+    the legacy single-tile lookahead, opt-in via TDT_MEGA_PF_DEPTH);
+    the ceiling of 4 bounds plan churn — deeper arenas stopped
+    converting cold opens well before 4 on the Qwen3 graphs
+    (tests/test_mega_core.py monotonicity corpus)."""
+    env = os.environ.get("TDT_MEGA_PF_DEPTH")
+    if env:
+        return max(1, int(env))
+    if not specs:
+        return 2
+    rect = max(kk for _, kk, _ in specs) * max(tn for _, _, tn in specs)
+    return max(2, min(4, pf_arena_bytes() // max(rect * 2, 1)))
 
 
 @dataclasses.dataclass
@@ -356,13 +374,17 @@ def prefetch_specs(tasks) -> Tuple[List[Tuple[str, int, int]], dict]:
     prefetchable only when every matmul using it shares one (K, TN) —
     the single arena-tile geometry the issuer and consumer must agree
     on. Shared by kernel.compile_graph (builds the arena) and
-    plan_prefetch/validate_schedule (assign and check the hints)."""
+    plan_prefetch/validate_schedule (assign and check the hints). Tiles
+    come from the byte-budgeted plan_mm_tiles map — the same map the
+    kernel tiles with."""
+    tn_of = plan_mm_tiles([t.branch_key for t in tasks
+                           if t.op == "matmul"])
     name_dims: dict = {}
     for t in tasks:
         if t.op != "matmul":
             continue
         k = t.branch_key
-        name_dims.setdefault(k[1], set()).add((k[2], fit_mm_tile(k[3])))
+        name_dims.setdefault(k[1], set()).add((k[2], tn_of[k]))
     specs: List[Tuple[str, int, int]] = []
     code_of: dict = {}
     for wname in sorted(name_dims):
@@ -373,13 +395,13 @@ def prefetch_specs(tasks) -> Tuple[List[Tuple[str, int, int]], dict]:
     return specs, code_of
 
 
-def _matmul_nt(task) -> int:
+def _matmul_nt(task, tn_of) -> int:
     n_cols = task.branch_key[3]
-    return n_cols // fit_mm_tile(n_cols)
+    return n_cols // tn_of[task.branch_key]
 
 
 def plan_prefetch(graph: Graph, sched: "Schedule",
-                  depth: int = 2) -> PrefetchPlan:
+                  depth: Optional[int] = None) -> PrefetchPlan:
     """Assign each prefetchable matmul a rotating arena slot and an
     issuing predecessor row in the same queue.
 
@@ -402,6 +424,10 @@ def plan_prefetch(graph: Graph, sched: "Schedule",
     tasks = graph.tasks
     n = len(tasks)
     specs, code_of = prefetch_specs(tasks)
+    tn_of = plan_mm_tiles([t.branch_key for t in tasks
+                           if t.op == "matmul"])
+    if depth is None:
+        depth = auto_pf_depth(specs)
     plan = PrefetchPlan(
         depth=depth, specs=specs,
         issue_code=np.zeros(n, np.int32),
@@ -423,7 +449,7 @@ def plan_prefetch(graph: Graph, sched: "Schedule",
                 # issuer row IS the slot's previous consumer: only safe
                 # when it reads its own tile before issuing (nt > 1)
                 prev = tasks[q[isr]]
-                ok = prev.op == "matmul" and _matmul_nt(prev) > 1
+                ok = prev.op == "matmul" and _matmul_nt(prev, tn_of) > 1
             elif ok:
                 ok = isr > lo
             if not ok:
@@ -446,6 +472,8 @@ def _validate_prefetch(graph: Graph, sched: "Schedule",
     and every prefetchable matmul either consumes or is flagged cold."""
     tasks = graph.tasks
     specs, code_of = prefetch_specs(tasks)
+    tn_of = plan_mm_tiles([t.branch_key for t in tasks
+                           if t.op == "matmul"])
     assert plan.specs == specs, "prefetch plan built for a different graph"
     cold = set(plan.cold)
     seen = set()
@@ -463,7 +491,7 @@ def _validate_prefetch(graph: Graph, sched: "Schedule",
             # same-row ordering: nt>1 matmuls consume then issue;
             # everything else (incl. nt==1 under depth>1) issues first
             consume_first = (is_consumer and cons > 0
-                             and _matmul_nt(t) > 1)
+                             and _matmul_nt(t, tn_of) > 1)
 
             def do_consume():
                 slot = cons - 1
@@ -641,14 +669,17 @@ def schedule_graph(
     """Schedule + plan a Graph. use_native=None auto-selects the C++ lib.
 
     pf_depth sets the weight-prefetch arena depth the plan is built for
-    (default: TDT_MEGA_PF_DEPTH env or 2); the returned schedule carries
+    (default: byte-aware auto_pf_depth from the graph's tile rectangle;
+    TDT_MEGA_PF_DEPTH pins it); the returned schedule carries
     `prefetch` (PrefetchPlan) and `stall` (predicted per-queue scoreboard
     stall), both asserted by validate_schedule."""
     n = len(graph.tasks)
     if n == 0:
         raise ValueError("empty megakernel graph")
     if pf_depth is None:
-        pf_depth = default_pf_depth()
+        # byte-aware default: size the rotating arena from this graph's
+        # actual tile rectangle (auto_pf_depth; TDT_MEGA_PF_DEPTH wins)
+        pf_depth = auto_pf_depth(prefetch_specs(graph.tasks)[0])
     strat = STRATEGIES[strategy]
     edges = graph.edges
     cost = [t.cost for t in graph.tasks]
@@ -764,7 +795,7 @@ def validate_schedule(graph: Graph, sched: Schedule) -> None:
     # prefetch-coverage invariant (weight-streaming pipeline)
     plan = sched.prefetch
     if plan is None:
-        plan = plan_prefetch(graph, sched, depth=default_pf_depth())
+        plan = plan_prefetch(graph, sched)
     else:
         _validate_prefetch(graph, sched, plan)
     # predicted-stall invariant: raw-edge and monotone-watermark

@@ -70,6 +70,67 @@ def _dtype_bytes(dtype) -> int:
     return jnp.dtype(dtype).itemsize
 
 
+def kernel_vmem_ceiling(chip: Optional[ChipSpec] = None) -> int:
+    """VMEM budget a single forced/tuned kernel candidate may plan
+    against: half the chip's VMEM, capped at 64 MiB. The conservative
+    per-kernel dataclass defaults (14-15 MiB) exist for the AUTO
+    fallback decision — where exceeding VMEM silently flips regimes —
+    but using them to prune the measured candidate set was cutting the
+    frontier exactly where the roofline says the winners live (wide
+    tiles, nk==1 direct-store): on a 128 MiB v5e the model's best
+    configs need 30-63 MiB. The cap keeps a compile-failure margin —
+    Mosaic needs headroom beyond the declared scratch."""
+    chip = chip or detect_chip()
+    return min((chip.vmem_mb << 20) // 2, 64 << 20)
+
+
+# -- HBM burst-efficiency model (megakernel byte-accurate floor) ------------
+
+# Effective-bandwidth penalty of short strided bursts. A DMA whose
+# contiguous runs are `burst` bytes long sustains roughly
+# burst / (burst + HBM_BURST_GAP_BYTES) of peak — the gap term folds
+# per-burst row turnaround and descriptor overhead into one constant.
+# Calibrated on the round-5 32B megakernel ledger: with the legacy
+# 512-column tiles (gate_up/qkv streaming in 512-byte bursts, o/down in
+# 1024-byte bursts) the model prices the 9.76 ms raw-byte floor at
+# ~11.4 ms, against 11.50 ms measured — the "missing 1.7 ms" the old
+# floor could not attribute was mostly burst inefficiency, not stalls
+# (trace attribution showed scoreboard/sem waits near zero at 1 queue).
+HBM_BURST_GAP_BYTES = 96.0
+
+
+def hbm_stream_efficiency(burst_bytes: Optional[float],
+                          gap_bytes: float = HBM_BURST_GAP_BYTES) -> float:
+    """Fraction of peak HBM bandwidth sustained at this contiguous
+    burst length; None (or non-positive) means a contiguous stream."""
+    if burst_bytes is None or burst_bytes <= 0:
+        return 1.0
+    b = float(burst_bytes)
+    return b / (b + gap_bytes)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficTerm:
+    """One HBM traffic component of a kernel/step byte ledger."""
+
+    name: str
+    nbytes: int
+    burst_bytes: Optional[float] = None  # None = contiguous
+
+
+def streamed_floor_ms(terms, chip: Optional[ChipSpec] = None) -> float:
+    """Byte-accurate HBM floor: each term streams at the effective
+    bandwidth its burst length sustains. This is the floor a schedule
+    that hides every stall would still pay — gap-vs-floor ratios above
+    1.0 are attributable work (stalls, uncounted bytes), not layout."""
+    chip = chip or detect_chip()
+    bw = chip.hbm_gbps * 1e9
+    return sum(
+        t.nbytes / (bw * hbm_stream_efficiency(t.burst_bytes))
+        for t in terms
+    ) * 1e3
+
+
 # -- GEMM model (ref: gemm_perf_model.py:61-126) ----------------------------
 
 
@@ -360,6 +421,100 @@ def choose_ep_chunks(
         dtype=dtype, payload_dtype=payload_dtype, chip=chip,
         overlap=overlap,
     ))
+
+
+# -- megakernel decode byte ledger (world=1 latency ledger) -----------------
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def mega_decode_traffic_terms(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    s_max: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    tiled_weights=("w_gate_up",),
+):
+    """The per-step HBM byte ledger of the Qwen3 megakernel decode
+    (mega/qwen3.build_qwen3_graph), as TrafficTerm rows.
+
+    This replaces the weights-only floor that round 5 showed cannot
+    explain the measured 32B step: it counts every byte class the
+    schedule must move — weights AT THEIR ACTUAL TILE BURST LENGTHS
+    (the same core.plan_mm_tiles map the kernel tiles with; tile-major
+    weights stream contiguously), the lm_head matmul, the f32 norm
+    stripes, the KV pages, the rope stripes, and the workspace
+    store/load round trips (counted un-forwarded: the store/forward
+    pipeline saves some of these, so the floor is a hair conservative
+    on that one small term). Dims are the PER-RANK shard (what one chip
+    streams)."""
+    from triton_dist_tpu.lang.core import min_tile
+    from triton_dist_tpu.mega.core import plan_mm_tiles
+
+    L = num_layers
+    isz = _dtype_bytes(dtype)
+    pb = _round_up(max(batch, 1), min_tile(dtype)[0])
+    wqkv = (hq_loc + 2 * hkv_loc) * head_dim
+    hqd = hq_loc * head_dim
+    kw = hkv_loc * head_dim
+    hqdp = _round_up(hqd, 128)
+    kwp = _round_up(kw, 128)
+
+    mm = {  # wname -> (K, N), mirroring build_qwen3_graph's branch keys
+        "w_qkv": (hidden, wqkv),
+        "w_o": (hqd, hidden),
+        "w_gate_up": (hidden, 2 * inter_loc),
+        "w_down": (inter_loc, hidden),
+    }
+    tn_of = plan_mm_tiles([("matmul", w, k, n, None, 0.0)
+                           for w, (k, n) in mm.items()])
+    terms = []
+    for w, (k, n) in sorted(mm.items()):
+        tn = tn_of[("matmul", w, k, n, None, 0.0)]
+        burst = None if w in tiled_weights else tn * isz
+        terms.append(TrafficTerm(w, L * k * n * isz, burst))
+    # lm_head runs as a plain XLA dot outside the kernel: contiguous
+    terms.append(TrafficTerm("lm_head", hidden * vocab_loc * isz))
+    # f32 norm stripes: 8-row full-width rows, contiguous
+    nw = _round_up(max(hidden, head_dim), 128)
+    terms.append(TrafficTerm("norms", (4 * L + 1) * 8 * nw * 4))
+    # rope cos|sin stripe per attention task per sequence
+    terms.append(TrafficTerm("rope", L * batch * 8 * head_dim * 4))
+    # KV pages (contiguous (page, D) blocks)
+    terms.append(TrafficTerm(
+        "kv", 2 * L * hkv_loc * batch * s_max * head_dim * isz))
+    # workspace round trips: per-task input loads + output stores at
+    # pb-row stripes (un-forwarded upper bound; rows are width*isz
+    # contiguous — burst effects are noise at these widths)
+    per_layer_cols = (
+        (hidden + wqkv)                    # ln1+qkv matmul
+        + (wqkv + hqdp + 2 * kwp)          # attention
+        + (hqdp + hidden)                  # o matmul
+        + 3 * hidden                       # ar_attn (+residual)
+        + (hidden + 2 * inter_loc)         # ln2+gate_up
+        + (2 * inter_loc + hidden)         # silu+down
+        + 3 * hidden                       # ar_mlp
+    )
+    ws_cols = L * per_layer_cols + 2 * hidden  # + final rms in/out
+    terms.append(TrafficTerm("workspace", pb * ws_cols * isz))
+    return terms
+
+
+def mega_decode_floor_ms(*args, chip: Optional[ChipSpec] = None,
+                         **kwargs) -> float:
+    """Byte-accurate megakernel decode floor (streamed_floor_ms over
+    mega_decode_traffic_terms) — what bench.py's mega_*_hbm_floor_ms
+    fields report since the world=1 ledger PR."""
+    return streamed_floor_ms(
+        mega_decode_traffic_terms(*args, **kwargs), chip)
 
 
 def estimate_ag_gemm_ms(

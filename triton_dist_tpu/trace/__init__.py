@@ -61,6 +61,7 @@ from triton_dist_tpu.trace.attribution import (  # noqa: F401
     format_table,
     per_region,
     prefetch_hit_rate,
+    task_time_by_branch,
 )
 from triton_dist_tpu.trace.export import (  # noqa: F401
     group_profile,

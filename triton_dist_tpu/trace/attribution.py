@@ -233,6 +233,30 @@ def compare_predicted(sched, tl: Timeline, stream: str = "mega",
     return report
 
 
+def task_time_by_branch(tl: Timeline, branch_keys=None,
+                        stream: str = "mega") -> Dict[object, dict]:
+    """Per-branch megakernel task-time buckets: "mega.task" spans carry
+    payload=branch id, so the traced step decomposes into matmul vs
+    attention vs AR vs elementwise time — the first cut of the world=1
+    ledger workflow (docs/performance.md): a latency regression names
+    its branch before anyone stares at a timeline.
+
+    branch_keys (CompiledMega.branch_keys) maps ids to keys; without it
+    the buckets key on the raw branch id. Returns
+    {key_or_id: {"time": total span time, "count": spans}}."""
+    out: Dict[object, dict] = {}
+    for s in tl.spans:
+        if s.stream != stream or ev.region_name(s.region) != "mega.task":
+            continue
+        key = s.payload
+        if branch_keys is not None and 0 <= s.payload < len(branch_keys):
+            key = branch_keys[s.payload]
+        d = out.setdefault(key, {"time": 0.0, "count": 0})
+        d["time"] += s.dur
+        d["count"] += 1
+    return out
+
+
 def prefetch_hit_rate(tl: Timeline,
                       stream: str = "mega") -> Optional[float]:
     """Fraction of prefetch-arena consumes that hit (payload > 0) among
