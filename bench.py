@@ -23,6 +23,10 @@ stay driver-visible — round-2 ADVICE):
   pallas_ag_gemm_ms / xla_gemm_ms — the forced Pallas AG+GEMM grid vs
   XLA's matmul on the identical shape; their ratio is the fused-kernel
   MFU gap the judge tracks.
+  serve_* / prefill_* — the serving plane under Poisson load (round 6:
+  continuous batching vs the sequential one-at-a-time baseline, tokens/s
+  + p50/p99 TTFT/TPOT at two QPS levels) and the prefill latency floor
+  TTFT decomposes into (see bench_serving's methodology note).
   raw — the chain timings behind the headline number.
 
 Methodology: the TPU sits behind a ~90 ms-RTT tunnel, so one dispatch is
@@ -629,6 +633,129 @@ def bench_sp_decode_partial(mesh):
     return r, pm * 1e3, xm * 1e3
 
 
+def _bench_prefill_chain(mesh, eng, seq_len, k_hi=21, pairs=7):
+    """Chunk-free prefill latency at (B=1, seq_len) in the serve plane's
+    "ar" mode — the serving floor the scheduler's chunking amortizes
+    against (VERDICT missing #5: prefill was the one phase bench.py
+    never tracked). Data-dependent chain: each iteration's first token
+    is the previous iteration's argmax; the KV cache is rebuilt from
+    zeros inside the body (prefill is a fresh-cache operation)."""
+    from triton_dist_tpu.models.kv_cache import KVCache
+
+    cfg = eng.cfg
+    world = mesh.devices.size
+    hkv_loc = cfg.num_kv_heads // world
+    base = jnp.zeros((1, seq_len), jnp.int32)
+
+    def build(k):
+        def per_rank(params, tok, base):
+            def body(_, t):
+                toks = jnp.concatenate([t[:, None], base[:, 1:]], axis=1)
+                cache = KVCache.create(cfg.num_layers, 1, seq_len,
+                                       hkv_loc, cfg.head_dim,
+                                       jnp.dtype(cfg.dtype))
+                logits, _ = forward(cfg, params, toks, cache, mode="ar",
+                                    axis="tp")
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+
+            return jax.lax.fori_loop(0, k, body, tok)
+
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=mesh,
+                in_specs=(param_specs("tp"), P(None), P(None)),
+                out_specs=P(None), check_vma=False,
+            )
+        )
+
+    return _chain_timer(build, (eng.params, jnp.zeros((1,), jnp.int32),
+                                base), k_hi=k_hi, pairs=pairs)
+
+
+def drive_poisson(sch, prompts, arrivals, gen_len):
+    """Submit `prompts` into `sch` at the given arrival offsets
+    (seconds, ascending) while stepping the scheduler, until every
+    request finishes; returns sch.metrics(). Shared by the two serving
+    arms (and unit-tested on a tiny engine in tests/test_serve.py)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    i = 0
+    while True:
+        now = _time.perf_counter() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            sch.submit(prompts[i], max_new_tokens=gen_len)
+            i += 1
+        if sch.step():
+            continue
+        if i >= len(prompts):
+            break
+        _time.sleep(max(0.0, min(arrivals[i] - (_time.perf_counter() - t0),
+                                 0.005)))
+    m = sch.metrics()
+    assert m["n"] == len(prompts), f"lost requests: {m['n']}"
+    return m
+
+
+def bench_serving(mesh, qps_levels=(1.0, 4.0), n_requests=10,
+                  prompt_len=96, gen_len=12):
+    """The serving plane under a Poisson arrival trace (ISSUE 6): the
+    continuous-batching scheduler vs the one-request-at-a-time
+    sequential baseline (same geometry, same compiled step,
+    max_active=1) at >= 2 QPS levels, on the Qwen3-8B per-rank shard.
+
+    Metrics are production serving stats — tokens/s over the run,
+    p50/p99 TTFT and TPOT per request — measured on the wall clock.
+    Methodology caveat (docs/serving.md): each scheduler step is a host
+    round trip, so on the driver's ~90 ms-RTT tunnel the absolute
+    TTFT/TPOT values are RTT-dominated; they are reported as honest
+    wall-clock serving latencies on THIS link. The batched/sequential
+    tokens-per-second RATIO is link-robust — both arms pay the same
+    per-step overhead, which is exactly what in-flight batching
+    amortizes across slots. Also emits the prefill floor metrics
+    (`prefill_us`, `prefill_s128_us`) the TTFT decomposes into."""
+    from triton_dist_tpu.serve import Scheduler
+
+    cfg = _shard_cfg()
+    eng = Engine(cfg, mesh, decode_mode="ar", max_len=CTX,
+                 fast_init=True)
+    out = {}
+    for key, s in (("prefill_us", CTX - 1), ("prefill_s128_us", 128)):
+        ms, raw = _bench_prefill_chain(mesh, eng, s)
+        out[key] = round(ms * 1e3, 2)
+        out[key.replace("_us", "_raw")] = raw
+
+    SLOTS, CHUNK, PAGE = 4, 64, 64
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    def run_arm(qps, max_active):
+        sch = Scheduler(eng, slots=SLOTS, chunk=CHUNK, page=PAGE,
+                        max_active=max_active)
+        arrivals = np.cumsum(
+            np.random.default_rng(23).exponential(1.0 / qps, n_requests))
+        return drive_poisson(sch, prompts, arrivals, gen_len)
+
+    levels = {}
+    for qps in qps_levels:
+        levels[f"qps{qps:g}"] = {
+            "batched": run_arm(qps, SLOTS),
+            "sequential": run_arm(qps, 1),
+        }
+    hi = levels[f"qps{max(qps_levels):g}"]
+    out["serve_tokens_per_s"] = hi["batched"]["tokens_per_s"]
+    out["serve_seq_tokens_per_s"] = hi["sequential"]["tokens_per_s"]
+    out["serve_vs_seq_tokens"] = round(
+        hi["batched"]["tokens_per_s"]
+        / max(hi["sequential"]["tokens_per_s"], 1e-9), 4)
+    for stat in ("ttft_p50_us", "ttft_p99_us", "tpot_p50_us",
+                 "tpot_p99_us"):
+        out[f"serve_{stat}"] = hi["batched"][stat]
+    out["serve_levels"] = levels
+    return out
+
+
 TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
 
 
@@ -753,16 +880,37 @@ _NUMERIC_KEYS = {
     "gemm_rs_kernel_ms", "gemm_rs_xla_ms", "gemm_rs_vs_xla",
     "sp_decode_partial_t64k_us", "sp_decode_partial_xla_us",
     "sp_decode_partial_vs_xla",
+    # a2a_dispatch_us (the pre-rename alias) rode round 6 deprecated and
+    # is now gone — the world1-suffixed key is the only trend line
     "a2a_dispatch_world1_us",
-    "a2a_dispatch_us",  # DEPRECATED alias of the world1 key (one round)
     "ep_moe_fwd_us", "ep_moe_seq_us", "ep_moe_xla_us",
     "ep_moe_overlap_vs_seq", "ep_moe_chunks", "ep_moe_drop_frac",
     "overhead_frac",
+    # serving plane (ISSUE 6): throughput + tail latency under load,
+    # and the prefill floor TTFT decomposes into
+    "serve_tokens_per_s", "serve_seq_tokens_per_s",
+    "serve_vs_seq_tokens",
+    "serve_ttft_p50_us", "serve_ttft_p99_us",
+    "serve_tpot_p50_us", "serve_tpot_p99_us",
+    "prefill_us", "prefill_s128_us",
 }
+# the serving headline keys travel together: a round that emits any of
+# them must emit them all (p50 without p99 would undo the round-5
+# tail-stat discipline for the one metric class where tails ARE the
+# product), plus the per-level breakdown
+_SERVE_KEYS = {
+    "serve_tokens_per_s", "serve_seq_tokens_per_s",
+    "serve_vs_seq_tokens",
+    "serve_ttft_p50_us", "serve_ttft_p99_us",
+    "serve_tpot_p50_us", "serve_tpot_p99_us",
+}
+_SERVE_LEVEL_STATS = ("tokens_per_s", "ttft_p50_us", "ttft_p99_us",
+                      "tpot_p50_us", "tpot_p99_us")
 # free-form chain timings; any such dict carrying paired diffs MUST
 # also carry its lower-tail stats (p25_ms/min_ms) — the 32B round-5
 # noise-vs-regression question was unfalsifiable without them
-_OTHER_KEYS = {"raw", "mega_32b_raw"}
+_OTHER_KEYS = {"raw", "mega_32b_raw", "prefill_raw", "prefill_s128_raw",
+               "serve_levels"}
 
 
 def check_result(result: dict) -> list:
@@ -800,6 +948,31 @@ def check_result(result: dict) -> list:
         else:
             problems.append(f"unknown key {k!r} (schema drift — add it "
                             "to bench._NUMERIC_KEYS/_STRING_KEYS)")
+    present = _SERVE_KEYS & set(result)
+    if present:
+        for k in _SERVE_KEYS - set(result):
+            problems.append(
+                f"serving keys travel together: {k!r} missing while "
+                f"{sorted(present)[0]!r} is present")
+        levels = result.get("serve_levels")
+        if not isinstance(levels, dict) or len(levels) < 2:
+            problems.append(
+                "serve_levels must carry >= 2 QPS levels beside the "
+                "serve_* headline keys")
+        else:
+            for lvl, arms in levels.items():
+                for arm in ("batched", "sequential"):
+                    stats = (arms or {}).get(arm)
+                    if not isinstance(stats, dict):
+                        problems.append(
+                            f"serve_levels[{lvl!r}] missing the "
+                            f"{arm!r} arm")
+                        continue
+                    for s in _SERVE_LEVEL_STATS:
+                        if s not in stats:
+                            problems.append(
+                                f"serve_levels[{lvl!r}][{arm!r}] "
+                                f"missing {s!r}")
     return problems
 
 
@@ -913,21 +1086,26 @@ def main():
     except Exception as e:
         result["sp_decode_partial_error"] = str(e)[:200]
     try:
-        a2a_us = round(bench_a2a_dispatch(mesh), 2)
         # canonical key carries the world=1 caveat in its NAME (round-5
         # VERDICT: a bare a2a_dispatch_us beside the 32-rank DeepEP
         # baseline invites a false "beats DeepEP" read — this is the
         # zero-ICI-bytes kernel cost of the dispatch path on one chip).
-        # The old key rides along one round as a deprecated alias so the
-        # driver's trend line survives the rename.
-        result["a2a_dispatch_world1_us"] = a2a_us
-        result["a2a_dispatch_us"] = a2a_us  # DEPRECATED alias
+        # The deprecated pre-rename alias rode round 6 and is now gone.
+        result["a2a_dispatch_world1_us"] = round(
+            bench_a2a_dispatch(mesh), 2)
     except Exception as e:
         result["a2a_dispatch_world1_error"] = str(e)[:200]
     try:
         result.update(bench_ep_moe(mesh))
     except Exception as e:
         result["ep_moe_error"] = str(e)[:200]
+    try:
+        # serving plane (ISSUE 6): continuous batching under Poisson
+        # load + the prefill floor — see bench_serving's methodology
+        # note on what the tunnel does to absolute TTFT/TPOT.
+        result.update(bench_serving(mesh))
+    except Exception as e:
+        result["serve_error"] = str(e)[:200]
 
     if "--trace" in sys.argv:
         # opt-in observability pass (never on the driver's default path):

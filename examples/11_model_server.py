@@ -1,11 +1,18 @@
-"""Tutorial 11 — model server: serving decode over a socket.
+"""Tutorial 11 — model server: continuous-batching serving over a socket.
 
 Port of the reference's megakernel model server + chat client
 (ref: mega_triton_kernel/test/models/model_server.py:112-193 socket
-server, chat.py): a server process owns the compiled engine and replays
-the jit'd decode step per request; clients send token ids over a local
-socket and stream back generated ids. Here the server runs in a thread
-(one process owns the TPU/mesh; the socket is the serving boundary).
+server, chat.py), upgraded to the serving plane (docs/serving.md): the
+server owns ONE `serve.Scheduler` running in a background thread, and
+every connection ENQUEUES into it instead of making a blocking
+per-request `eng.serve` call — concurrent clients' prefill chunks and
+decode steps share the same jit'd step, and tokens stream back over the
+socket as they are generated.
+
+Protocol (JSON lines): request {"ids": [[...]], "gen_len": N}; the
+server streams {"tok": t} per generated token, then {"gen": [[...]]}.
+Errors keep the envelope contract: one {"error": ...} line, so the
+client never hangs on a server fault.
 
 Run:  python examples/11_model_server.py [--tpu]
 """
@@ -20,61 +27,122 @@ from common import bootstrap
 jax, mesh = bootstrap(world=4)
 
 from triton_dist_tpu.models import Engine, ModelConfig  # noqa: E402
+from triton_dist_tpu.serve import Scheduler  # noqa: E402
 
 GEN = 6
 
 
-def serve(sock, eng):
-    """Accept {\"ids\": [[...]]} JSON lines; reply {\"gen\": [[...]]} (or
-    {\"error\": ...} so the client never hangs on a server fault)."""
-    while True:
-        conn, _ = sock.accept()
+def serve(sock, sch):
+    """Accept {\"ids\": [[...]]} JSON lines; enqueue into the scheduler
+    and stream tokens back (or {\"error\": ...} so the client never
+    hangs). Each connection gets its own handler THREAD — a handler
+    blocks consuming its request's stream, so serial handling would
+    quietly reduce the server to one request at a time; with threads
+    the scheduler continuously batches whatever is in flight."""
+    stop_evt = threading.Event()
+
+    def handle(conn):
         with conn:
             f = conn.makefile("rw")
             line = f.readline()
             if not line:
-                continue
+                return
             try:
                 req = json.loads(line)
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
                 if req.get("op") == "stop":
+                    stop_evt.set()
+                    sock.close()  # unblocks the accept loop
                     return
                 ids = np.asarray(req["ids"], np.int32)
-                out = eng.serve(ids, req.get("gen_len", GEN))
-                resp = {"gen": np.asarray(out).tolist()}
+                assert ids.shape[0] == 1, "one sequence per connection"
+                r = sch.submit(ids[0].tolist(),
+                               max_new_tokens=req.get("gen_len", GEN),
+                               stream=True)
+                for tok, _piece in r.stream:  # streams as the batch runs
+                    f.write(json.dumps({"tok": tok}) + "\n")
+                    f.flush()
+                f.write(json.dumps({"gen": [r.out_tokens]}) + "\n")
             except Exception as e:  # surface to the client
                 import traceback
 
                 traceback.print_exc()
-                resp = {"error": str(e)[:300]}
-            f.write(json.dumps(resp) + "\n")
+                f.write(json.dumps({"error": str(e)[:300]}) + "\n")
             f.flush()
+
+    while not stop_evt.is_set():
+        try:
+            conn, _ = sock.accept()
+        except OSError:  # listening socket closed by the stop handler
+            return
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+
+def chat(port, prompt, gen_len=GEN):
+    """Chat-client leg (ref chat.py): send one prompt, consume the token
+    stream, return (streamed tokens, final gen line)."""
+    c = socket.create_connection(("localhost", port))
+    with c:
+        f = c.makefile("rw")
+        f.write(json.dumps({"ids": prompt, "gen_len": gen_len}) + "\n")
+        f.flush()
+        streamed = []
+        while True:
+            resp = json.loads(f.readline())
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            if "tok" in resp:
+                streamed.append(resp["tok"])
+            else:
+                return streamed, resp["gen"][0]
 
 
 def main():
     cfg = ModelConfig.tiny(max_positions=32)
     eng = Engine(cfg, mesh, prefill_mode="xla", decode_mode="ar",
                  donate_cache=False, max_len=32)
+    sch = Scheduler(eng, slots=2, chunk=4, page=8)
+    sch.start()  # background serving thread owns the device
 
     sock = socket.socket()
     sock.bind(("localhost", 0))
     sock.listen()
     port = sock.getsockname()[1]
-    t = threading.Thread(target=serve, args=(sock, eng), daemon=True)
+    t = threading.Thread(target=serve, args=(sock, sch), daemon=True)
     t.start()
 
-    # chat client (ref chat.py): two requests over the socket
-    for prompt in ([[5, 3, 9, 2]], [[1, 1, 2, 8]]):
-        c = socket.create_connection(("localhost", port))
-        with c:
-            f = c.makefile("rw")
-            f.write(json.dumps({"ids": prompt, "gen_len": GEN}) + "\n")
-            f.flush()
-            resp = json.loads(f.readline())
-        gen = resp["gen"][0]
-        assert len(gen) == GEN
-        print(f"11 model server: prompt {prompt[0]} -> generated {gen}")
+    # two CONCURRENT chat clients: their requests are continuously
+    # batched through the one scheduler (the point of this tutorial)
+    prompts = ([[5, 3, 9, 2]], [[1, 1, 2, 8]])
+    results = {}
+
+    def client(i):
+        results[i] = chat(port, prompts[i])
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    for i, prompt in enumerate(prompts):
+        streamed, final = results[i]
+        assert streamed == final and len(final) == GEN
+        print(f"11 model server: prompt {prompt[0]} -> streamed {streamed}")
+    # the two requests really were batched: a serial server would need
+    # 2 * (1 prefill chunk + 6 decode) = 14 steps
+    assert sch.worker.n_steps < 14, (
+        f"requests were served serially ({sch.worker.n_steps} steps)"
+    )
+
+    # bad request exercises the error envelope
+    c = socket.create_connection(("localhost", port))
+    with c:
+        f = c.makefile("rw")
+        f.write(json.dumps({"ids": "not-a-batch"}) + "\n")
+        f.flush()
+        assert "error" in json.loads(f.readline())
 
     c = socket.create_connection(("localhost", port))
     with c:
@@ -82,7 +150,9 @@ def main():
         f.write(json.dumps({"op": "stop"}) + "\n")
         f.flush()
     t.join(timeout=10)
-    print("11 model server: served 2 requests over the socket — OK")
+    sch.stop()
+    print("11 model server: streamed 2 concurrent requests through the "
+          "scheduler — OK")
 
 
 if __name__ == "__main__":

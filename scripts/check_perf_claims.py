@@ -57,14 +57,24 @@ SCAN_GLOBS = (
 )
 
 # (key, repo-relative file) pairs that MUST carry a claim: the
-# historically drifting ones. Removing the sentence is as loud as
+# historically drifting ones, plus the serving plane's load-bearing
+# batching claim (ISSUE 6). Removing the sentence is as loud as
 # contradicting it.
 REQUIRED_CLAIMS = (
     ("pallas_vs_xla", "triton_dist_tpu/kernels/allgather_gemm.py"),
     ("pallas_vs_xla", "docs/performance.md"),
     ("gemm_rs_vs_xla", "triton_dist_tpu/kernels/gemm_reduce_scatter.py"),
     ("gemm_rs_vs_xla", "docs/performance.md"),
+    ("serve_vs_seq_tokens", "docs/serving.md"),
 )
+
+# Keys whose claims are REQUIRED but whose first measurement is still in
+# flight (a metric added this round has no BENCH_r*.json behind it yet):
+# the claim must exist and be schema-valid, and it IS checked against
+# any artifact that carries the key — only the "unbacked" fail-closed
+# rule is deferred. Each entry rides until the first artifact measuring
+# it lands, then must be removed so the rule closes again.
+PENDING_FIRST_ARTIFACT = {"serve_vs_seq_tokens"}
 
 FLOAT_TOL = 0.005  # slack for exact-value claims (rounding in the JSON)
 
@@ -180,12 +190,17 @@ def check(repo: str = _REPO, verbose: bool = False) -> int:
                     f"{rel}: claims {key} in [{lo}, {hi}] but {src} "
                     f"measured {got}")
         elif label is not None and key in required_keys:
-            # fail CLOSED: a load-bearing claim no artifact (current or
-            # prior) backs is exactly the silent detachment this tool
-            # exists to prevent
-            problems.append(
-                f"{rel}: required claim {key!r} is not measured by ANY "
-                "bench artifact — the claim is unbacked")
+            if key in PENDING_FIRST_ARTIFACT:
+                print(f"check_perf_claims: {rel}: {key!r} awaits its "
+                      "first bench artifact (PENDING_FIRST_ARTIFACT)",
+                      file=sys.stderr)
+            else:
+                # fail CLOSED: a load-bearing claim no artifact (current
+                # or prior) backs is exactly the silent detachment this
+                # tool exists to prevent
+                problems.append(
+                    f"{rel}: required claim {key!r} is not measured by "
+                    "ANY bench artifact — the claim is unbacked")
         if verbose:
             print(f"{rel}: [perf:{key}={lo}-{hi}] {status}")
 

@@ -147,7 +147,6 @@ def _ok_result():
         "vs_baseline": 0.5, "raw": dict(raw),
         "mega_decode_qwen3_32b_ms": 10.0, "mega_32b_raw": dict(raw),
         "a2a_dispatch_world1_us": 128.0,
-        "a2a_dispatch_us": 128.0,
     }
 
 
@@ -170,12 +169,16 @@ def test_check_result_requires_tail_stats():
 def test_check_result_a2a_world1_key():
     import bench
 
-    # canonical renamed key + the one-round deprecated alias are both
-    # schema-legal; a fabricated third spelling is schema drift
+    # only the canonical renamed key is schema-legal: the pre-rename
+    # alias rode round 6 deprecated and is now schema DRIFT, like any
+    # fabricated spelling
     assert "a2a_dispatch_world1_us" in bench._NUMERIC_KEYS
     bad = _ok_result()
     bad["a2a_dispatch_p50_us"] = 1.0
     assert any("unknown key" in p for p in bench.check_result(bad))
+    gone = _ok_result()
+    gone["a2a_dispatch_us"] = 128.0
+    assert any("unknown key" in p for p in bench.check_result(gone))
 
 
 def test_chain_timer_raw_carries_tail_stats():

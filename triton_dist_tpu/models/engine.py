@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.models.config import ModelConfig
@@ -69,6 +70,8 @@ class Engine:
         self.axis = axis
         self.batch_axis = batch_axis
         self.max_len = max_len or cfg.max_positions
+        self.prefill_mode = prefill_mode
+        self.decode_mode = decode_mode
         self.params = (
             params if params is not None
             else init_params(cfg, mesh, seed, axis, fast=fast_init)
@@ -117,6 +120,11 @@ class Engine:
         # per-request step counts must not accumulate executables forever.
         self._gen_cache: dict = {}
         self._gen_cache_max = 8
+        # compiled serve-step executables, keyed on the batch-of-
+        # sequence-states geometry (see make_serve_step) — bounded like
+        # _gen_cache, and shared between Engine.serve's stepwise path
+        # and the serve-plane Worker so both replay ONE executable.
+        self._serve_cache: dict = {}
 
     def _gen_fn(self, steps: int, greedy: bool):
         key = (steps, greedy)
@@ -180,6 +188,115 @@ class Engine:
         temp = jnp.asarray(max(temperature, 1e-6), jnp.float32)
         return fn(self.params, tok, cache, key, temp)
 
+    # -- serve step (batch-of-sequence-states contract) ---------------------
+
+    def make_serve_step(self, slots: int, chunk: int, page: int,
+                        max_pages: int):
+        """ONE jit'd step function over a shared paged-KV pool — the
+        contract the continuous-batching serve plane replays
+        (triton_dist_tpu.serve; ref: the model_server loop replaying
+        the captured decode graph, mega_triton_kernel/test/models/
+        model_server.py).
+
+        Geometry is FIXED at (slots, chunk): every step runs the model
+        over a (slots, chunk) token block in `decode_mode`, whatever
+        mixture of prefill chunks and single-token decode steps the
+        scheduler packed into it. A slot's row carries `n_valid` real
+        tokens (prefill: up to `chunk` prompt tokens; decode: 1;
+        inactive: 0) starting at its current sequence length; the rest
+        of the row is padding whose outputs are discarded and whose KV
+        writes are routed to the pool's reserved null page. Because the
+        geometry never changes and XLA's row numerics are independent
+        of the CONTENT and COLUMN PLACEMENT of other rows (only of the
+        operand shapes), each request's tokens are bitwise invariant to
+        batch composition, slot placement, chunk alignment, and
+        eviction/re-prefill — the property tests/test_serve.py pins.
+
+        Signature of the returned callable:
+          fn(params, tokens (K, C) i32, pool_k, pool_v
+             (L, Hkv, P, page, D) — megakernel pool layout, shared with
+             mega.qwen3.PagedMegaKVCache — table (K, MAXP) i32,
+             lengths (K,) i32, n_valid (K,) i32, temps (K,) f32,
+             keys (K, 2) u32)
+          -> (next_token (K,) i32, last_logits (K, V) f32,
+              pool_k, pool_v)
+
+        next_token is greedy argmax where temps<=0, else categorical on
+        logits/temp under the slot's key — keys are derived host-side
+        from (request seed, token index), so sampled generations are
+        ALSO scheduling-invariant. Pool buffers are donated when the
+        engine was built with donate_cache=True."""
+        key = (slots, chunk, page, max_pages)
+        fn = self._serve_cache.pop(key, None)
+        if fn is None:
+            fn = self._build_serve_step(slots, chunk, page, max_pages)
+            while len(self._serve_cache) >= self._gen_cache_max:
+                self._serve_cache.pop(next(iter(self._serve_cache)))
+        self._serve_cache[key] = fn  # re-insert = LRU touch
+        return fn
+
+    def _build_serve_step(self, slots: int, chunk: int, page: int,
+                          max_pages: int):
+        cfg = self.cfg
+        mode = self.decode_mode
+        axis = self.axis
+        t_pool = max_pages * page
+        assert t_pool <= cfg.max_positions, (
+            f"pool horizon {t_pool} exceeds max_positions "
+            f"{cfg.max_positions} (rope table)"
+        )
+        n = int(self.mesh.shape[axis])
+        if mode in ("dist", "xla"):
+            assert (slots * chunk) % n == 0, (
+                f"sequence-sharded mode {mode!r} needs slots*chunk "
+                f"({slots}*{chunk}) divisible by tp={n}"
+            )
+
+        def per_rank(params, tokens, pool_k, pool_v, table, lengths,
+                     n_valid, temps, keys):
+            cache = KVCache.dense_view(pool_k, pool_v, table, lengths)
+            logits, new_cache = forward(
+                cfg, params, tokens, cache, mode=mode, axis=axis,
+                return_full_logits=True,
+            )  # logits (K, C, V) f32, new_cache k/v (L, K, T, Hkv, D)
+            bidx = jnp.arange(slots)[:, None]
+            last = logits[jnp.arange(slots),
+                          jnp.maximum(n_valid - 1, 0)]  # (K, V)
+            greedy = jnp.argmax(last, -1).astype(jnp.int32)
+            temp = jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, last / temp
+            ).astype(jnp.int32)
+            tok = jnp.where(temps > 0.0, sampled, greedy)
+
+            # scatter this step's K/V rows back into the pool: valid
+            # columns land on their table pages; padding columns are
+            # routed to page 0, the pool's reserved null page (their
+            # positions may sit past the slot's allocated pages, whose
+            # table entries still map to live pages of OTHER slots)
+            pos = lengths[:, None] + jnp.arange(chunk)[None, :]  # (K, C)
+            posc = jnp.minimum(pos, t_pool - 1)
+            valid = jnp.arange(chunk)[None, :] < n_valid[:, None]
+            pg = jnp.where(valid, table[bidx, posc // page], 0)
+            off = posc % page
+            kn = jnp.moveaxis(new_cache.k[:, bidx, posc], 3, 1)
+            vn = jnp.moveaxis(new_cache.v[:, bidx, posc], 3, 1)
+            pool_k = pool_k.at[:, :, pg, off].set(kn.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, :, pg, off].set(vn.astype(pool_v.dtype))
+            return tok, last, pool_k, pool_v
+
+        pool_spec = P(None, self.axis)
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=self.mesh,
+                in_specs=((self._wrap_specs[0], P(), pool_spec, pool_spec)
+                          + (P(),) * 5),
+                out_specs=(P(), P(), pool_spec, pool_spec),
+                check_vma=False,
+            ),
+            donate_argnums=(2, 3) if self._donate_cache else (),
+        )
+
     # -- API ----------------------------------------------------------------
 
     def new_cache(self, batch: int) -> KVCache:
@@ -213,10 +330,33 @@ class Engine:
         gen_len: int,
         temperature: float = 0.0,
         seed: int = 0,
+        slots: Optional[int] = None,
+        chunk: Optional[int] = None,
+        page: Optional[int] = None,
     ):
         """Prefill + gen_len decode steps (ref Engine.serve,
         engine.py:113-189). Returns generated ids (B, gen_len). The
-        decode phase is ONE `generate` dispatch (see module doc)."""
+        decode phase is ONE `generate` dispatch (see module doc).
+
+        With `slots` set, serve instead runs the STEPWISE path: the
+        request batch is admitted into a fresh continuous-batching
+        scheduler (triton_dist_tpu.serve) over the (slots, chunk)
+        serve-step geometry — the sequential baseline the serve plane's
+        in-flight batching is bit-identical to (docs/serving.md).
+        Sampling then uses per-request key streams (seed + row index),
+        not the legacy batch-shared key."""
+        if slots is not None:
+            from triton_dist_tpu.serve import Scheduler
+
+            ids = np.asarray(input_ids, np.int32)
+            sch = Scheduler(self, slots=slots, chunk=chunk, page=page)
+            reqs = [
+                sch.submit(list(map(int, row)), max_new_tokens=gen_len,
+                           temperature=temperature, seed=seed + i)
+                for i, row in enumerate(ids)
+            ]
+            sch.run()
+            return jnp.asarray([r.out_tokens for r in reqs], jnp.int32)
         key = jax.random.PRNGKey(seed)
         logits, cache = self.prefill(input_ids)
         key, sub = jax.random.split(key)

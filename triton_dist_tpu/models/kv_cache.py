@@ -25,6 +25,26 @@ class KVCache(NamedTuple):
     length: jax.Array  # (B,) valid entries per sequence
 
     @staticmethod
+    def dense_view(pool_k, pool_v, table, lengths) -> "KVCache":
+        """Dense (L, B, T, Hkv, D) view of a PAGED pool — the serve
+        plane's read path (serve/kv_pool.KVPool): pool_k/pool_v are
+        shared page pools in megakernel pool layout (L, Hkv, P, page, D)
+        and `table` (B, MAXP) maps each sequence's page grid onto pool
+        pages. The gather is a pure copy, so values round-trip bitwise —
+        paging is an allocation policy, never a numeric one. Unallocated
+        table entries point at page 0 (the pool's reserved null page);
+        the garbage they gather sits beyond each sequence's `lengths`
+        and is masked by attention's kv_len/causal bounds."""
+        L, Hkv, _, page, D = pool_k.shape
+        B, maxp = table.shape
+        t = maxp * page
+        k = jnp.moveaxis(pool_k[:, :, table].reshape(L, Hkv, B, t, D),
+                         1, 3)
+        v = jnp.moveaxis(pool_v[:, :, table].reshape(L, Hkv, B, t, D),
+                         1, 3)
+        return KVCache(k, v, lengths)
+
+    @staticmethod
     def create(num_layers, batch, max_len, num_kv_heads, head_dim,
                dtype=jnp.bfloat16) -> "KVCache":
         shape = (num_layers, batch, max_len, num_kv_heads, head_dim)

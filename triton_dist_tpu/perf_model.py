@@ -517,6 +517,105 @@ def mega_decode_floor_ms(*args, chip: Optional[ChipSpec] = None,
         mega_decode_traffic_terms(*args, **kwargs), chip)
 
 
+# -- serving-plane step model (ISSUE 6 tentpole (c)) -------------------------
+
+
+def estimate_serve_step_ms(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    n_tokens: int,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+) -> float:
+    """Roofline of ONE mixed prefill+decode serve step
+    (models/engine.make_serve_step) processing `n_tokens` real tokens
+    (prefill-chunk columns + decode slots combined) against `kv_tokens`
+    of resident context across the batch.
+
+    The term structure is what makes continuous batching pay: the
+    per-step WEIGHT stream (the whole per-rank shard — the decode
+    floor's dominant term at bs=1) is paid ONCE regardless of how many
+    tokens ride the step, so packing prefill chunks beside decode slots
+    amortizes it; the COMPUTE term grows with n_tokens and eventually
+    flips the step compute-bound — the crossover the chunk chooser
+    walks. KV/activation traffic ride along as minor terms. Ranks
+    scheduler choices; does not promise wall-clock."""
+    chip = chip or detect_chip()
+    b = _dtype_bytes(dtype)
+    hqd, kwd = hq_loc * head_dim, hkv_loc * head_dim
+    w_bytes = num_layers * (
+        hidden * (hqd + 2 * kwd)      # qkv
+        + hqd * hidden                # o
+        + hidden * 2 * inter_loc      # gate|up
+        + inter_loc * hidden          # down
+    ) * b + hidden * vocab_loc * b    # lm_head
+    kv_bytes = 2 * num_layers * kwd * kv_tokens * b
+    act_bytes = n_tokens * num_layers * (4 * hidden + 3 * inter_loc) * b
+    mem_ms = (w_bytes + kv_bytes + act_bytes) / (chip.hbm_gbps * 1e9) * 1e3
+
+    flops = 2.0 * n_tokens * (
+        num_layers * (hidden * (hqd + 2 * kwd) + hqd * hidden
+                      + 3 * hidden * inter_loc)
+        + hidden * vocab_loc
+    )
+    # efficiency WITHOUT the short-m penalty: at small token counts the
+    # step is weight-stream-bound and the MXU consumes rows as they
+    # arrive (the measured decode step sits on the HBM floor, not a
+    # short-m MXU cliff) — the m penalty would wrongly flip tiny steps
+    # compute-bound and break the amortization story the chunk chooser
+    # depends on
+    compute_ms = flops / (
+        chip.bf16_tflops * 1e12 * 0.85
+        * mxu_efficiency(max(n_tokens, 1024), hidden, hidden)
+    ) * 1e3
+    return max(compute_ms, mem_ms)
+
+
+def choose_prefill_chunk(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    slots: int = 4,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    stall_budget: float = 2.0,
+    candidates=(1, 2, 4, 8, 16, 32, 64, 128),
+) -> int:
+    """Model-guided prefill chunk size for the Scheduler: the largest
+    candidate whose mixed step (one slot prefilling `chunk` tokens, the
+    rest decoding) stays within `stall_budget` x the decode-only step —
+    bigger chunks finish prefill (and thus TTFT) in fewer steps, but
+    every extra chunk column delays EVERY in-flight decode slot's next
+    token (TPOT), so the budget caps the decode stall a prefill may
+    inject. While the step is weight-stream-bound the marginal chunk
+    column is nearly free and the pick is large; once compute-bound the
+    pick clamps. Returns at least candidates[0]."""
+    args = (num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+            vocab_loc)
+    base = estimate_serve_step_ms(*args, n_tokens=max(slots, 1),
+                                  kv_tokens=kv_tokens, dtype=dtype,
+                                  chip=chip)
+    best = candidates[0]
+    for c in sorted(candidates):
+        mixed = estimate_serve_step_ms(
+            *args, n_tokens=c + max(slots - 1, 0),
+            kv_tokens=kv_tokens, dtype=dtype, chip=chip)
+        if mixed <= stall_budget * base:
+            best = c
+    return best
+
+
 def estimate_ag_gemm_ms(
     m: int,
     k: int,

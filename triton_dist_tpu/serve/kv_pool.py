@@ -1,0 +1,200 @@
+"""KVPool — shared paged KV storage for the serving plane.
+
+The pool generalizes `mega.qwen3.PagedMegaKVCache` from a per-model
+snapshot into a SERVING resource (ref: mega_triton_kernel/models/
+paged_kv_cache.py): k/v are shared page pools in the megakernel pool
+layout (L, Hkv, P, page, D) — so a pool slice exports straight into the
+megakernel's paged decode path (`as_mega_cache`) — and the page table
+maps SLOTS (bounded concurrency lanes of the fixed-geometry serve step)
+onto pool pages. Where the megakernel cache bump-allocates and never
+frees, the pool runs a full allocator lifecycle: allocate-on-admit,
+grow-per-chunk, free-on-finish, and eviction (reclaim a victim's pages
+so a higher-priority request can run; the victim requeues and
+re-prefills bit-identically — engine.make_serve_step).
+
+Page 0 is RESERVED (the null page): unallocated table entries point at
+it, and the serve step routes padding-column KV writes to it, so a
+garbage write can never land on another sequence's live page. The
+allocator therefore hands out pages [1, P) and `capacity` excludes the
+reserved page.
+
+Host/device split: page bookkeeping (free list, per-slot page lists,
+lengths) is host-side numpy — the scheduler reads it every step — while
+k/v live on device and are donated through the step function.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def pages_for(n_tokens: int, page: int) -> int:
+    """ceil(n_tokens / page) — the page demand of a sequence."""
+    return -(-n_tokens // page)
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages (and the caller chose not to evict)."""
+
+
+class KVPool:
+    """Shared paged KV pool over `slots` concurrency lanes.
+
+    total_pages counts ALLOCATABLE pages (the reserved null page is
+    added on top); it defaults to full provisioning
+    (slots * max_pages), and smaller pools oversubscribe — the point of
+    paging — with eviction as the pressure valve.
+    """
+
+    def __init__(self, engine, slots: int, page: int,
+                 max_pages: Optional[int] = None,
+                 total_pages: Optional[int] = None):
+        cfg = engine.cfg
+        assert engine.max_len % page == 0, (
+            f"page {page} must divide the engine horizon "
+            f"{engine.max_len}"
+        )
+        self.engine = engine
+        self.slots = slots
+        self.page = page
+        self.max_pages = max_pages or engine.max_len // page
+        self.t_max = self.max_pages * page
+        self.capacity = (total_pages if total_pages is not None
+                         else slots * self.max_pages)
+        assert self.capacity >= 1, "pool needs at least one page"
+
+        n = int(engine.mesh.shape[engine.axis])
+        hkv = cfg.num_kv_heads // n * n
+        dt = jnp.dtype(cfg.dtype)
+        shape = (cfg.num_layers, hkv, 1 + self.capacity, page,
+                 cfg.head_dim)
+        sharding = NamedSharding(engine.mesh,
+                                 P(None, engine.axis, None, None, None))
+        self.k = jax.device_put(jnp.zeros(shape, dt), sharding)
+        self.v = jax.device_put(jnp.zeros(shape, dt), sharding)
+
+        self.table = np.zeros((slots, self.max_pages), np.int32)
+        self.lengths = np.zeros((slots,), np.int32)
+        self._free: List[int] = list(range(self.capacity, 0, -1))  # pop=1 first
+        self._pages: List[Optional[List[int]]] = [None] * slots  # None=free
+
+    # -- queries --------------------------------------------------------
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def used_pages(self, slot: Optional[int] = None) -> int:
+        if slot is not None:
+            ps = self._pages[slot]
+            return 0 if ps is None else len(ps)
+        return sum(len(p) for p in self._pages if p is not None)
+
+    def free_slot(self) -> Optional[int]:
+        for s, p in enumerate(self._pages):
+            if p is None:
+                return s
+        return None
+
+    def check(self) -> None:
+        """Allocator invariants (leak/aliasing guard): every page is in
+        exactly one place — one slot's list or the free list — and the
+        null page is in neither."""
+        held = [pg for ps in self._pages if ps is not None for pg in ps]
+        all_pages = held + self._free
+        assert 0 not in all_pages, "null page leaked into the allocator"
+        assert len(all_pages) == len(set(all_pages)), (
+            "page aliased across slots/free list"
+        )
+        assert sorted(all_pages) == list(range(1, self.capacity + 1)), (
+            f"page leak: {len(all_pages)} accounted, "
+            f"{self.capacity} allocatable"
+        )
+        for s, ps in enumerate(self._pages):
+            if ps is not None:
+                assert list(self.table[s, :len(ps)]) == ps, (
+                    f"slot {s} table drifted from its page list"
+                )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def admit(self, slot: int, n_tokens: int) -> None:
+        """Claim `slot` and allocate pages for an n_tokens history
+        (allocate-on-admit). Raises PoolExhausted/AssertionError rather
+        than partially allocating."""
+        assert self._pages[slot] is None, f"slot {slot} already in use"
+        need = max(pages_for(n_tokens, self.page), 1)
+        assert need <= self.max_pages, (
+            f"{n_tokens} tokens need {need} pages > table width "
+            f"{self.max_pages}"
+        )
+        if need > len(self._free):
+            raise PoolExhausted(
+                f"need {need} pages, {len(self._free)} free"
+            )
+        self._pages[slot] = [self._free.pop() for _ in range(need)]
+        self.table[slot, :need] = self._pages[slot]
+        self.lengths[slot] = 0
+
+    def ensure(self, slot: int, upto_tokens: int) -> bool:
+        """Grow `slot`'s allocation to cover `upto_tokens` (all-or-
+        nothing). False = exhausted; the scheduler then evicts or
+        stalls the slot."""
+        ps = self._pages[slot]
+        assert ps is not None, f"slot {slot} is not admitted"
+        need = pages_for(upto_tokens, self.page) - len(ps)
+        if need <= 0:
+            return True
+        assert len(ps) + need <= self.max_pages, (
+            f"slot {slot}: {upto_tokens} tokens exceed the "
+            f"{self.max_pages}-page table"
+        )
+        if need > len(self._free):
+            return False
+        new = [self._free.pop() for _ in range(need)]
+        self.table[slot, len(ps):len(ps) + need] = new
+        ps.extend(new)
+        return True
+
+    def release(self, slot: int) -> None:
+        """Free `slot` and return its pages (free-on-finish / eviction).
+        Double-free is an assertion, not a silent no-op."""
+        ps = self._pages[slot]
+        assert ps is not None, f"double free of slot {slot}"
+        self._free.extend(reversed(ps))
+        self._pages[slot] = None
+        self.table[slot] = 0
+        self.lengths[slot] = 0
+
+    # -- export ---------------------------------------------------------
+
+    def to_dense(self):
+        """Host-side dense (L, B, T, Hkv, D) models.KVCache snapshot
+        (pure gather; bitwise — tests and the mega bridge use it)."""
+        from triton_dist_tpu.models.kv_cache import KVCache
+
+        return KVCache.dense_view(self.k, self.v,
+                                  jnp.asarray(self.table),
+                                  jnp.asarray(self.lengths))
+
+    def as_mega_cache(self):
+        """Snapshot the pool as a mega.qwen3.PagedMegaKVCache — the
+        layouts are IDENTICAL (that was the point of adopting the
+        megakernel pool layout), so the megakernel's paged decode path
+        runs directly over serve-plane state. The megakernel's bump
+        allocator resumes at the pool high-water mark; note it will NOT
+        see pages freed back to this pool's free list (export is a
+        decode handoff, not shared ownership)."""
+        from triton_dist_tpu.mega.qwen3 import PagedMegaKVCache
+
+        high = max((max(ps) for ps in self._pages if ps), default=0)
+        return PagedMegaKVCache(
+            k=self.k, v=self.v,
+            table=jnp.asarray(self.table),
+            length=jnp.asarray(self.lengths),
+            next_free=jnp.asarray(high + 1, jnp.int32),
+        )

@@ -1,0 +1,394 @@
+"""Scheduler — continuous (in-flight) batching over the serve step.
+
+Each step assembles a HETEROGENEOUS batch: new requests' prefill chunks
+ride next to in-flight requests' decode steps in the same fixed
+(slots, chunk) token block, so admission never waits for the running
+batch to drain (the reference serves one blocking request at a time
+over its socket — model_server.py:112-193; this is the production shape
+of that loop). Policies:
+
+  admission   — priority order off the RequestQueue; a new request
+                needs a free slot + pages for its history
+                (allocate-on-admit). A STRICTLY higher-priority arrival
+                may evict the most-victimizable active request.
+  eviction    — victim order is (priority asc, least-recently-active,
+                youngest admission): "LRU/priority". Mid-flight page
+                exhaustion evicts only requests younger-or-lower than
+                the one needing room (a strict total order — no
+                thrash cycles); if every slot stalls, the most-
+                victimizable is evicted to guarantee progress. Evicted
+                requests requeue with their original arrival order and
+                re-prefill their full history — bit-identical to an
+                uninterrupted run (engine.make_serve_step).
+  completion  — eos_id or max_new_tokens; the slot and its pages free
+                immediately (free-on-finish).
+
+Tokens stream per request (callback/iterator, incremental
+detokenization) and every lifecycle phase is recorded as a host span
+(queued/prefill/decode, eviction instants) exportable to Perfetto via
+`timeline()` — the serving extension of the trace/ subsystem.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from triton_dist_tpu.serve.kv_pool import KVPool, PoolExhausted, pages_for
+from triton_dist_tpu.serve.queue import RequestQueue
+from triton_dist_tpu.serve.request import (
+    Detokenizer,
+    Request,
+    RequestState,
+    TokenStream,
+    summarize,
+)
+from triton_dist_tpu.serve.worker import Worker
+
+
+def _default_page(max_len: int) -> int:
+    for p in (64, 32, 16, 8, 4, 2, 1):
+        if max_len % p == 0:
+            return p
+    return 1
+
+
+class Scheduler:
+    def __init__(
+        self,
+        engine,
+        slots: int = 2,
+        chunk: Optional[int] = None,
+        page: Optional[int] = None,
+        max_pages: Optional[int] = None,
+        total_pages: Optional[int] = None,
+        max_active: Optional[int] = None,
+        queue: Optional[RequestQueue] = None,
+        detokenizer: Optional[Detokenizer] = None,
+    ):
+        page = page or _default_page(engine.max_len)
+        self.pool = KVPool(engine, slots, page, max_pages=max_pages,
+                           total_pages=total_pages)
+        if chunk is None:
+            from triton_dist_tpu.perf_model import choose_prefill_chunk
+
+            cfg = engine.cfg
+            n = int(engine.mesh.shape[engine.axis])
+            chunk = choose_prefill_chunk(
+                cfg.num_layers, cfg.hidden_size,
+                cfg.intermediate_size // n, cfg.num_q_heads // n,
+                cfg.num_kv_heads // n, cfg.head_dim,
+                cfg.vocab_size // n, slots=slots,
+                kv_tokens=self.pool.t_max, dtype=cfg.dtype,
+            )
+            chunk = max(1, min(chunk, self.pool.t_max))
+        self.chunk = chunk
+        self.worker = Worker(engine, self.pool, chunk)
+        self.queue = queue or RequestQueue()
+        self.max_active = max_active or slots
+        self.detok = detokenizer
+        self.active: dict = {}  # slot -> Request
+        self.requests: List[Request] = []
+        self._admit_seq = 0
+        self._spans: List[tuple] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- client API -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, priority: int = 0,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None, on_token=None,
+               stream: bool = False) -> Request:
+        """Enqueue one request (admission control may raise QueueFull).
+        Returns the live Request; read req.out_tokens after completion
+        or consume req.stream incrementally."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt) + max_new_tokens
+        if total > self.pool.t_max:
+            raise ValueError(
+                f"prompt+max_new_tokens={total} exceeds the pool "
+                f"horizon {self.pool.t_max}"
+            )
+        if pages_for(total, self.pool.page) > min(self.pool.max_pages,
+                                                 self.pool.capacity):
+            raise ValueError(
+                f"request needs {pages_for(total, self.pool.page)} "
+                "pages, beyond what this pool can ever hold"
+            )
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      priority=priority, temperature=temperature,
+                      seed=seed, eos_id=eos_id, on_token=on_token,
+                      stream=TokenStream() if stream else None)
+        # stamp the queued phase BEFORE the request becomes visible to a
+        # background serving thread — stamping after queue.submit could
+        # overwrite a prefill phase the scheduler thread already opened
+        # (a QueueFull rejection leaves only the stamp, never a span)
+        self._begin_phase(req, "queued")
+        self.queue.submit(req)
+        self.requests.append(req)
+        return req
+
+    def cancel(self, req: Request) -> None:
+        """Cancel queued or active; the slot frees on the next step."""
+        if req.done:
+            return
+        if req.state is RequestState.QUEUED and self.queue.cancel(req):
+            return
+        # active — or queue.cancel lost the race with a concurrent
+        # admission (threaded mode): flag it for the next step
+        if not req.done:
+            req.finish_reason = "cancel_requested"  # handled in step()
+
+    # -- the step -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: admit, assemble, run, postprocess.
+        Returns False when there was nothing to do."""
+        self._reap_cancelled()
+        self._admit()
+        if not self.active:
+            return False
+
+        K, C = self.pool.slots, self.chunk
+        tokens = np.zeros((K, C), np.int32)
+        n_valid = np.zeros((K,), np.int32)
+        temps = np.zeros((K,), np.float32)
+        keys = np.zeros((K, 2), np.uint32)
+        plans = []  # (slot, req, n, completes_chunk)
+
+        for slot in sorted(self.active):
+            req = self.active.get(slot)
+            if req is None:  # evicted by an earlier slot's _room call
+                continue
+            hist = req.history()
+            if req.state is RequestState.PREFILL:
+                n = min(C, len(hist) - req.pos)
+                if not self._room(slot, req, req.pos + n):
+                    continue  # stalled this step
+                tokens[slot, :n] = hist[req.pos:req.pos + n]
+                emits = req.pos + n == len(hist)
+            else:  # DECODE
+                n = 1
+                if not self._room(slot, req, len(hist) + 1):
+                    continue
+                tokens[slot, 0] = hist[-1]
+                emits = True
+            n_valid[slot] = n
+            if emits:
+                temps[slot] = req.temperature
+                keys[slot] = self.worker.key_for(req.seed,
+                                                 len(req.out_tokens))
+            plans.append((slot, req, n, emits))
+
+        # a later slot's page demand may have evicted an earlier,
+        # already-planned request (_room): scrub its row from the step
+        plans = [p for p in plans if self.active.get(p[0]) is p[1]]
+        live = {p[0] for p in plans}
+        for slot in range(K):
+            if slot not in live:
+                n_valid[slot] = 0
+                tokens[slot] = 0
+
+        if not plans:
+            # every slot stalled on pages: evict the most-victimizable
+            # to guarantee progress (its pages feed the others)
+            victim = min(self.active.values(), key=self._victim_order)
+            self._evict(victim)
+            return True
+
+        toks = self.worker.step(tokens, n_valid, temps, keys)
+
+        for slot, req, n, emits in plans:
+            req.last_active_step = self.worker.n_steps
+            if req.state is RequestState.PREFILL:
+                req.pos += n
+                if emits:
+                    self._phase(req, "decode")
+                    req.state = RequestState.DECODE
+                    self._emit(req, int(toks[slot]))
+            else:
+                self._emit(req, int(toks[slot]))
+        return True
+
+    def run(self, max_steps: int = 100_000) -> None:
+        """Drive steps until queue and slots drain."""
+        for _ in range(max_steps):
+            if not self.step() and self.queue.peek() is None:
+                return
+        raise RuntimeError(f"scheduler did not drain in {max_steps} steps")
+
+    def start(self) -> None:
+        """Background serving thread (the socket-server mode,
+        examples/11). A step failure must not strand streaming clients:
+        the loop fails every live request (closing its stream) and
+        parks the error on `self.error` instead of dying silently."""
+        assert self._thread is None, "already started"
+        self._stop.clear()
+        self.error: Optional[BaseException] = None
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    idle = not self.step()
+                except BaseException as e:  # noqa: BLE001 — see docstring
+                    self.error = e
+                    self._fail_all(f"scheduler error: {e!r}")
+                    return
+                if idle:
+                    time.sleep(0.002)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=30)
+            self._thread = None
+            if getattr(self, "error", None) is not None:
+                raise RuntimeError(
+                    "serving thread died on an error"
+                ) from self.error
+
+    def _fail_all(self, reason: str) -> None:
+        """Retire every live request (streams close, clients unblock)."""
+        for slot in list(self.active):
+            self._retire(self.active[slot], reason,
+                         RequestState.CANCELLED)
+        req = self.queue.pop()
+        while req is not None:
+            req._finish(reason, RequestState.CANCELLED)
+            req = self.queue.pop()
+
+    # -- metrics / observability ---------------------------------------
+
+    def metrics(self) -> dict:
+        return summarize(self.requests)
+
+    def timeline(self):
+        """Per-request lifecycle spans as a trace.Timeline (host spans
+        only) — write_trace() exports it to Perfetto beside the
+        in-kernel traces."""
+        from triton_dist_tpu.trace.collect import Timeline
+
+        return Timeline(events=[], spans=[], drops={},
+                        host_spans=list(self._spans), label="serve")
+
+    # -- internals ------------------------------------------------------
+
+    def _room(self, slot: int, req: Request, upto: int) -> bool:
+        if self.pool.ensure(slot, upto):
+            return True
+        victim = self._pick_victim(req)
+        while victim is not None:
+            self._evict(victim)
+            if self.pool.ensure(slot, upto):
+                return True
+            victim = self._pick_victim(req)
+        return False
+
+    @staticmethod
+    def _victim_order(a: Request):
+        # most victimizable first: lowest priority, least recently
+        # active (LRU), youngest admission
+        return (a.priority, a.last_active_step, -a.admit_seq)
+
+    def _pick_victim(self, requester: Request) -> Optional[Request]:
+        """Strictly 'younger-or-lower' victims relative to the
+        requester — a total order (admit_seq is unique), so two slots
+        can never evict each other in turns."""
+        cands = [
+            a for a in self.active.values()
+            if a is not requester
+            and (a.priority < requester.priority
+                 or (a.priority == requester.priority
+                     and a.admit_seq > requester.admit_seq))
+        ]
+        return min(cands, key=self._victim_order) if cands else None
+
+    def _admit(self) -> None:
+        while len(self.active) < self.max_active:
+            req = self.queue.peek()
+            if req is None:
+                return
+            slot = self.pool.free_slot()
+            need = max(pages_for(len(req.history()), self.pool.page), 1)
+            if slot is None or self.pool.free_pages() < need:
+                # a strictly higher-priority arrival may preempt
+                cands = [a for a in self.active.values()
+                         if a.priority < req.priority]
+                if not cands:
+                    return
+                self._evict(min(cands, key=self._victim_order))
+                continue
+            self.queue.pop()
+            try:
+                self.pool.admit(slot, len(req.history()))
+            except PoolExhausted:  # raced with nothing; be safe
+                self.queue.requeue(req)
+                return
+            req.slot = slot
+            req.pos = 0
+            req.state = RequestState.PREFILL
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self.active[slot] = req
+            self._phase(req, "prefill")
+
+    def _evict(self, req: Request) -> None:
+        self.pool.release(req.slot)
+        del self.active[req.slot]
+        req.slot = -1
+        req.pos = 0
+        req.n_evictions += 1
+        now = time.perf_counter_ns()
+        self._spans.append((f"req{req.request_id}/evicted", now, now))
+        self._phase(req, "queued")
+        self.queue.requeue(req)
+
+    def _emit(self, req: Request, tok: int) -> None:
+        piece = self.detok.piece(tok) if self.detok else None
+        req._emit(tok, piece)
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or len(req.out_tokens) >= req.max_new_tokens:
+            reason = ("eos" if req.eos_id is not None
+                      and tok == req.eos_id else "length")
+            self._retire(req, reason, RequestState.FINISHED)
+
+    def _retire(self, req: Request, reason: str, state) -> None:
+        self.pool.release(req.slot)
+        del self.active[req.slot]
+        req.slot = -1
+        self._end_phase(req)
+        req._finish(reason, state)
+
+    def _reap_cancelled(self) -> None:
+        for slot in list(self.active):
+            req = self.active[slot]
+            if req.finish_reason == "cancel_requested":
+                self._retire(req, "cancelled", RequestState.CANCELLED)
+
+    # -- span bookkeeping ----------------------------------------------
+
+    def _begin_phase(self, req: Request, name: str) -> None:
+        req._phase = (name, time.perf_counter_ns())
+
+    def _end_phase(self, req: Request) -> None:
+        ph = getattr(req, "_phase", None)
+        if ph is not None:
+            name, t0 = ph
+            self._spans.append((f"req{req.request_id}/{name}", t0,
+                                time.perf_counter_ns()))
+            req._phase = None
+
+    def _phase(self, req: Request, name: str) -> None:
+        self._end_phase(req)
+        self._begin_phase(req, name)

@@ -1,0 +1,58 @@
+"""Worker — replays the engine's ONE jit'd serve step over the pool.
+
+The scheduler/worker split of the Engine (ROADMAP item 1): the
+Scheduler decides WHAT runs each step (which slots, which tokens, how
+many are real); the Worker is the only component that touches the
+device — it materializes the step arguments, replays the single
+compiled executable `engine.make_serve_step` built for this geometry
+(the CUDA-graph-replay analog: same shapes every step, whatever the
+batch mixes), and folds the results back into the pool.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.serve.kv_pool import KVPool
+
+
+class Worker:
+    def __init__(self, engine, pool: KVPool, chunk: int):
+        self.engine = engine
+        self.pool = pool
+        self.chunk = chunk
+        self._fn = engine.make_serve_step(pool.slots, chunk, pool.page,
+                                          pool.max_pages)
+        self.n_steps = 0
+
+    def key_for(self, seed: int, token_index: int) -> np.ndarray:
+        """Per-(request, token) sampling key: derived from the request
+        seed and the OUTPUT TOKEN INDEX only, so sampled tokens — like
+        greedy ones — are invariant to scheduling and eviction."""
+        return np.asarray(
+            jax.random.fold_in(jax.random.PRNGKey(seed), token_index)
+        )
+
+    def step(self, tokens: np.ndarray, n_valid: np.ndarray,
+             temps: np.ndarray, keys: np.ndarray) -> np.ndarray:
+        """One serve step. tokens (K, C) i32 / n_valid (K,) i32 /
+        temps (K,) f32 / keys (K, 2) u32. Advances pool lengths by
+        n_valid and returns the per-slot next token (K,) i32 — only
+        slots whose chunk just completed (prefill tail or decode) carry
+        a meaningful token; the scheduler knows which."""
+        pool = self.pool
+        tok, _logits, pool.k, pool.v = self._fn(
+            self.engine.params,
+            jnp.asarray(tokens, jnp.int32),
+            pool.k, pool.v,
+            jnp.asarray(pool.table),
+            jnp.asarray(pool.lengths),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys, jnp.uint32),
+        )
+        pool.lengths = pool.lengths + np.asarray(n_valid, np.int32)
+        self.n_steps += 1
+        return np.asarray(tok)
