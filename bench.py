@@ -215,15 +215,20 @@ def bench_decode(mesh):
     return _chain_timer(build, (eng.params, tok, cache), k_hi=41, pairs=7)
 
 
-def bench_mlp(mesh, x, wg, wu, w2):
+def bench_mlp(mesh, x, wg, wu, w2, ag_config=None, rs_config=None):
     """TP-MLP dist path at the layer's native split gate/up layout (the
-    split is a storage-format choice made at init, not per-call work)."""
+    split is a storage-format choice made at init, not per-call work).
+    ag_config/rs_config: the fused-kernel candidate searches' winners —
+    the block inherits the swept wide-tm / nk==1 frontier instead of
+    re-paying the static defaults (ROADMAP item 5: tp_mlp_m2048 margin
+    under its 1.2x bar comes from here)."""
     def build(k):
         def per_rank(x, wg, wu, w2):
             params = TPMLPParams(wg, wu, w2)
 
             def body(_, c):
-                return tp_mlp_dist_fwd(c, params)
+                return tp_mlp_dist_fwd(c, params, ag_config=ag_config,
+                                       rs_config=rs_config)
 
             out = jax.lax.fori_loop(0, k, body, x)
             return jnp.sum(out.astype(jnp.float32)).reshape(1)
@@ -404,11 +409,15 @@ def bench_ep_moe(mesh, shape=(128, 7168, 8, 16, 1024), k_hi=21, pairs=7):
     return out
 
 
-def _search_best_vs_xla(candidates, build_one, xla_builder, args, label):
+def _search_best_vs_xla(candidates, build_one, xla_builder, args, label,
+                        ks=(1, 201, 401)):
     """Measure each candidate kernel builder against ONE memoized XLA arm
     (slope_ratio_timer; the identical baseline program must not recompile
-    per candidate) and return (ratio, pallas_ms, xla_ms, label) of the
-    winner. Shared by the two fused-kernel candidate searches."""
+    per candidate) and return (ratio, pallas_ms, xla_ms, label, winner)
+    of the winner — `winner` is the candidate object itself so callers
+    can thread the tuned config into downstream arms (the TP-MLP block
+    inherits the fused-kernel winners). Shared by the fused-kernel and
+    flash-prefill candidate searches."""
     from triton_dist_tpu.runtime.utils import slope_ratio_timer
 
     xla_cache = {}
@@ -421,11 +430,12 @@ def _search_best_vs_xla(candidates, build_one, xla_builder, args, label):
     best = None
     for cand in candidates:
         try:
-            r, pm, xm = slope_ratio_timer(build_one(cand), xla_memo, args)
+            r, pm, xm = slope_ratio_timer(build_one(cand), xla_memo, args,
+                                          ks=ks)
         except RuntimeError:
             continue
         if best is None or r < best[0]:
-            best = (r, pm, xm, label(cand))
+            best = (r, pm, xm, label(cand), cand)
     if best is None:
         raise RuntimeError("all candidate configs failed to measure")
     return best
@@ -492,7 +502,9 @@ def bench_ag_gemm_kernel(mesh, x, w1):
     world = mesh.devices.size
     m_loc, n_loc = x.shape[0] // world, w1.shape[1] // world
     seen = {repr(c) for c, _ in candidates}
-    for cfg in prune_ag_gemm_configs(m_loc, x.shape[1], n_loc, top_n=3):
+    # sweep the widened wide-tm / nk==1 direct-store frontier (PR 5
+    # opened the VMEM ceiling; this measures it): top_n 3 -> 6
+    for cfg in prune_ag_gemm_configs(m_loc, x.shape[1], n_loc, top_n=6):
         if repr(cfg) not in seen:
             seen.add(repr(cfg))
             candidates.append((cfg, "arrival"))
@@ -567,7 +579,7 @@ def bench_gemm_rs_kernel(mesh):
     candidates = [GemmRsConfig()]
     if mesh.devices.size == 1:
         seen = {repr(candidates[0])}
-        for cfg in prune_gemm_rs_local_configs(M, K_RS, HIDDEN, top_n=3):
+        for cfg in prune_gemm_rs_local_configs(M, K_RS, HIDDEN, top_n=6):
             if repr(cfg) not in seen:
                 seen.add(repr(cfg))
                 candidates.append(cfg)
@@ -633,13 +645,127 @@ def bench_sp_decode_partial(mesh):
     return r, pm * 1e3, xm * 1e3
 
 
-def _bench_prefill_chain(mesh, eng, seq_len, k_hi=21, pairs=7):
+def bench_sp_prefill(mesh, shape=(1, 4096, 4, 1, 128),
+                     ks=(1, 101, 201), k_hi=201, pairs=7):
+    """The SP flash-prefill fold at the Qwen3-8B per-rank head geometry
+    (B=1, S=T=4096, Hq=4, Hkv=1, D=128): the Pallas online-softmax
+    kernel (kernels/flash_prefill.py) vs the two XLA formulations it
+    replaces — `ring_attention` (at world=1: one dense _block_update
+    fold, the f32 (Hq, S, T) logits tensor materialized whole) and the
+    blockwise scan (`gqa_attention` impl="xla": logits materialized
+    chunk-by-chunk). The fold is rank-local, so world=1 measures the
+    real per-segment consumer cost; the cross-rank per-segment-semaphore
+    protocol is exercised by the 8-device dryrun.
+
+    Unlike the decode-partial arm, honesty here does not hinge on KV
+    residency: at S=4096 the XLA arms' 268 MB of per-iteration f32
+    logits traffic cannot be parked in VMEM, and the flash arm is
+    MXU-bound — the compared quantity is exactly the logits-
+    materialization tax the kernel deletes. Candidate KV page heights
+    come from the model-pruned space (autotuner.
+    prune_flash_prefill_configs); the winner's block is reported as
+    sp_prefill_cfg. Returns a dict of sp_prefill_* schema keys with
+    tail stats (the keys travel together; bench.check_result enforces
+    it). shape/ks/k_hi/pairs are overridable so the arm is smoke-
+    testable end-to-end on the CPU interpreter at tiny sizes
+    (tests/test_tuning.py) — an axis-binding or routing bug here must
+    fail a test, not silently error-key every future artifact."""
+    from triton_dist_tpu.autotuner import prune_flash_prefill_configs
+    from triton_dist_tpu.kernels.flash_prefill import (
+        FlashPrefillConfig,
+        fit_block,
+        flash_prefill_local,
+    )
+    from triton_dist_tpu.kernels.sp_attention import ring_attention
+    from triton_dist_tpu.layers.attention import gqa_attention
+    from triton_dist_tpu.runtime.utils import slope_ratio_timer
+
+    B, S, HQ, HKV, D = shape
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((B, S, HQ, D)) * 0.1,
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, HKV, D)) * 0.1,
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, HKV, D)) * 0.1,
+                    jnp.bfloat16)
+    kv_len = jnp.asarray([S - 5], jnp.int32)
+
+    # one-device sub-mesh: ring_attention needs its axis BOUND (a bare
+    # jit leaves "tp" unbound and crashes at trace time), and a 1-rank
+    # ring is exactly the local fold every arm must compare — the
+    # world=1 form of the measurement regardless of the driver's mesh
+    mesh1 = make_mesh(mesh_shape=(1,), axis_names=("tp",),
+                      devices=np.asarray(mesh.devices).flatten()[:1])
+
+    def chain(impl_fn):
+        def bld(kk):
+            def fn(q, k, v):
+                def body(_, c):
+                    o = impl_fn(c, k, v)
+                    o = jax.lax.optimization_barrier(o)
+                    return o.astype(c.dtype)
+
+                out = jax.lax.fori_loop(0, kk, body, q)
+                return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh1, in_specs=(P(), P(), P()),
+                out_specs=P(), check_vma=False))
+
+        return bld
+
+    def flash_fn(cfg):
+        # the same divisor re-fit the pruner ranked with: the measured
+        # geometry and the recorded sp_prefill_cfg never detach from
+        # the modeled one
+        blk = fit_block(S, cfg.block)
+        return lambda q, k, v: flash_prefill_local(
+            q, k, v, kv_len=kv_len, causal=True, block=blk)
+
+    def ring_fn(q, k, v):
+        # world=1 ring formulation: the single dense fold
+        return ring_attention(q, k, v, axis="tp", causal=True,
+                              kv_len=kv_len)
+
+    def xla_fn(q, k, v):
+        return gqa_attention(q, k, v, causal=True, kv_len=kv_len,
+                             prefill_impl="xla")
+
+    candidates = [FlashPrefillConfig()]
+    seen = {repr(candidates[0])}
+    for cfg in prune_flash_prefill_configs(S, S, HQ, HKV, D, top_n=2):
+        if repr(cfg) not in seen:
+            seen.add(repr(cfg))
+            candidates.append(cfg)
+    ratio, fl_ms, ring_ms, label, win = _search_best_vs_xla(
+        candidates, lambda c: chain(flash_fn(c)), chain(ring_fn),
+        (q, k, v), lambda c: f"block={fit_block(S, c.block)}", ks=ks)
+    xr, _, xla_ms = slope_ratio_timer(
+        chain(flash_fn(win)), chain(xla_fn), (q, k, v), ks=ks)
+    ms, raw = _chain_timer(chain(flash_fn(win)), (q, k, v), k_hi=k_hi,
+                           pairs=pairs)
+    return {
+        "sp_prefill_us": round(ms * 1e3, 2),
+        "sp_prefill_raw": raw,
+        "sp_prefill_ring_us": round(ring_ms * 1e3, 2),
+        "sp_prefill_xla_us": round(xla_ms * 1e3, 2),
+        "sp_prefill_vs_ring": round(ratio, 4),
+        "sp_prefill_vs_xla": round(xr, 4),
+        "sp_prefill_cfg": label,
+    }
+
+
+def _bench_prefill_chain(mesh, eng, seq_len, k_hi=21, pairs=7,
+                         attn_impl=None):
     """Chunk-free prefill latency at (B=1, seq_len) in the serve plane's
     "ar" mode — the serving floor the scheduler's chunking amortizes
     against (VERDICT missing #5: prefill was the one phase bench.py
     never tracked). Data-dependent chain: each iteration's first token
     is the previous iteration's argmax; the KV cache is rebuilt from
-    zeros inside the body (prefill is a fresh-cache operation)."""
+    zeros inside the body (prefill is a fresh-cache operation).
+    attn_impl: the prefill-attention implementation to force ("xla" |
+    "pallas"; None = the serving plane's auto switch) — the serve-side
+    arm of the flash-prefill movement measurement."""
     from triton_dist_tpu.models.kv_cache import KVCache
 
     cfg = eng.cfg
@@ -655,7 +781,7 @@ def _bench_prefill_chain(mesh, eng, seq_len, k_hi=21, pairs=7):
                                        hkv_loc, cfg.head_dim,
                                        jnp.dtype(cfg.dtype))
                 logits, _ = forward(cfg, params, toks, cache, mode="ar",
-                                    axis="tp")
+                                    axis="tp", attn_impl=attn_impl)
                 return jnp.argmax(logits, -1).astype(jnp.int32)
 
             return jax.lax.fori_loop(0, k, body, tok)
@@ -724,6 +850,15 @@ def bench_serving(mesh, qps_levels=(1.0, 4.0), n_requests=10,
         ms, raw = _bench_prefill_chain(mesh, eng, s)
         out[key] = round(ms * 1e3, 2)
         out[key.replace("_us", "_raw")] = raw
+    # serve-side flash-prefill movement arm: the same chain with the
+    # legacy xla attention forced — prefill_us rides the auto switch
+    # (the Pallas flash kernel on native TPU), so the ratio is the TTFT
+    # floor movement the device-side kernel buys the serving plane
+    xla_ms, _ = _bench_prefill_chain(mesh, eng, CTX - 1,
+                                     attn_impl="xla")
+    out["prefill_xla_us"] = round(xla_ms * 1e3, 2)
+    out["prefill_flash_vs_xla"] = round(
+        out["prefill_us"] / max(out["prefill_xla_us"], 1e-9), 4)
 
     SLOTS, CHUNK, PAGE = 4, 64, 64
     rng = np.random.default_rng(17)
@@ -864,7 +999,7 @@ def write_arm_traces(mesh, x, w1, out_dir):
 # that a nonzero exit instead (CI catches metric drift).
 _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 _STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
-                "gemm_rs_tuned_cfg", "trace_dir"}
+                "gemm_rs_tuned_cfg", "sp_prefill_cfg", "trace_dir"}
 # signed numerics: legitimately negative (an overhead measurement can
 # read slightly below zero in chain-timer noise) — exempt from the
 # `v < 0` malformed-value rule, never from finiteness
@@ -893,6 +1028,20 @@ _NUMERIC_KEYS = {
     "serve_ttft_p50_us", "serve_ttft_p99_us",
     "serve_tpot_p50_us", "serve_tpot_p99_us",
     "prefill_us", "prefill_s128_us",
+    # serve-side flash-prefill movement arm (ISSUE 7): the auto-switch
+    # chain vs the forced-xla chain at the same shape
+    "prefill_xla_us", "prefill_flash_vs_xla",
+    # SP flash prefill (ISSUE 7): the Pallas online-softmax fold vs the
+    # two XLA formulations it replaces (keys travel together)
+    "sp_prefill_us", "sp_prefill_ring_us", "sp_prefill_xla_us",
+    "sp_prefill_vs_ring", "sp_prefill_vs_xla",
+}
+# the SP-prefill keys travel together: a round that emits any of them
+# must emit them all plus the tail-stat raw dict — a ratio without its
+# absolute arms (or vice versa) is unfalsifiable from the artifact
+_SP_PREFILL_KEYS = {
+    "sp_prefill_us", "sp_prefill_ring_us", "sp_prefill_xla_us",
+    "sp_prefill_vs_ring", "sp_prefill_vs_xla",
 }
 # the serving headline keys travel together: a round that emits any of
 # them must emit them all (p50 without p99 would undo the round-5
@@ -910,7 +1059,7 @@ _SERVE_LEVEL_STATS = ("tokens_per_s", "ttft_p50_us", "ttft_p99_us",
 # also carry its lower-tail stats (p25_ms/min_ms) — the 32B round-5
 # noise-vs-regression question was unfalsifiable without them
 _OTHER_KEYS = {"raw", "mega_32b_raw", "prefill_raw", "prefill_s128_raw",
-               "serve_levels"}
+               "serve_levels", "sp_prefill_raw"}
 
 
 def check_result(result: dict) -> list:
@@ -948,6 +1097,17 @@ def check_result(result: dict) -> list:
         else:
             problems.append(f"unknown key {k!r} (schema drift — add it "
                             "to bench._NUMERIC_KEYS/_STRING_KEYS)")
+    sp_present = _SP_PREFILL_KEYS & set(result)
+    if sp_present:
+        for k in _SP_PREFILL_KEYS - set(result):
+            problems.append(
+                f"sp_prefill keys travel together: {k!r} missing while "
+                f"{sorted(sp_present)[0]!r} is present")
+        raw = result.get("sp_prefill_raw")
+        if not isinstance(raw, dict) or "diffs_ms" not in raw:
+            problems.append(
+                "sp_prefill_raw (tail-stat chain dict) must ride "
+                "beside the sp_prefill_* keys")
     present = _SERVE_KEYS & set(result)
     if present:
         for k in _SERVE_KEYS - set(result):
@@ -1050,6 +1210,7 @@ def main():
         result["mega_32b_gap_vs_floor"] = round(ms32 / floor32, 4)
     except Exception as e:
         result["mega_32b_error"] = str(e)[:200]
+    ag_win = rs_win = None
     try:
         rng = np.random.default_rng(0)
         dt = jnp.bfloat16
@@ -1058,11 +1219,7 @@ def main():
             rng.standard_normal((HIDDEN, N_GATE_UP * world)) * 0.02, dt)
         w2 = jnp.asarray(
             rng.standard_normal((K_DOWN * world, HIDDEN)) * 0.02, dt)
-        half = w1.shape[1] // 2
-        mlp_ms, _ = bench_mlp(mesh, x, w1[:, :half], w1[:, half:], w2)
-        result["tp_mlp_m2048_ms"] = round(mlp_ms, 4)
-        result["tp_mlp_vs_baseline"] = round(mlp_ms / _BASELINE_MLP_MS, 4)
-        ratio, pallas_ms, xla_ms, ag_cfg = bench_ag_gemm_kernel(
+        ratio, pallas_ms, xla_ms, ag_cfg, ag_win = bench_ag_gemm_kernel(
             mesh, x, w1)
         result["pallas_ag_gemm_ms"] = round(pallas_ms, 4)
         result["xla_gemm_ms"] = round(xla_ms, 4)
@@ -1071,13 +1228,31 @@ def main():
     except Exception as e:
         result["secondary_metric_error"] = str(e)[:200]
     try:
-        rs_ratio, rs_ms, rs_xla_ms, rs_cfg = bench_gemm_rs_kernel(mesh)
+        rs_ratio, rs_ms, rs_xla_ms, rs_cfg, rs_win = \
+            bench_gemm_rs_kernel(mesh)
         result["gemm_rs_kernel_ms"] = round(rs_ms, 4)
         result["gemm_rs_xla_ms"] = round(rs_xla_ms, 4)
         result["gemm_rs_vs_xla"] = round(rs_ratio, 4)
         result["gemm_rs_tuned_cfg"] = rs_cfg
     except Exception as e:
         result["gemm_rs_error"] = str(e)[:200]
+    try:
+        # the MLP block runs AFTER the kernel searches so it inherits
+        # their swept winners (ROADMAP item 5: the wide-tm / nk==1
+        # frontier margin lands in tp_mlp_m2048 too, not just the
+        # per-kernel ratios)
+        half = w1.shape[1] // 2
+        mlp_ms, _ = bench_mlp(mesh, x, w1[:, :half], w1[:, half:], w2,
+                              ag_config=ag_win[0] if ag_win else None,
+                              rs_config=rs_win)
+        result["tp_mlp_m2048_ms"] = round(mlp_ms, 4)
+        result["tp_mlp_vs_baseline"] = round(mlp_ms / _BASELINE_MLP_MS, 4)
+    except Exception as e:
+        result["tp_mlp_error"] = str(e)[:200]
+    try:
+        result.update(bench_sp_prefill(mesh))
+    except Exception as e:
+        result["sp_prefill_error"] = str(e)[:200]
     try:
         fd_ratio, fd_us, fd_xla_us = bench_sp_decode_partial(mesh)
         result["sp_decode_partial_t64k_us"] = round(fd_us, 2)

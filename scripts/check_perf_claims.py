@@ -66,15 +66,34 @@ REQUIRED_CLAIMS = (
     ("gemm_rs_vs_xla", "triton_dist_tpu/kernels/gemm_reduce_scatter.py"),
     ("gemm_rs_vs_xla", "docs/performance.md"),
     ("serve_vs_seq_tokens", "docs/serving.md"),
+    ("sp_prefill_vs_ring", "triton_dist_tpu/kernels/flash_prefill.py"),
+    ("sp_prefill_vs_ring", "docs/performance.md"),
+    ("sp_prefill_vs_xla", "docs/performance.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still in
-# flight (a metric added this round has no BENCH_r*.json behind it yet):
-# the claim must exist and be schema-valid, and it IS checked against
-# any artifact that carries the key — only the "unbacked" fail-closed
-# rule is deferred. Each entry rides until the first artifact measuring
-# it lands, then must be removed so the rule closes again.
-PENDING_FIRST_ARTIFACT = {"serve_vs_seq_tokens"}
+# flight. The open-ended grace set this used to be (PR 6) was itself a
+# fail-open: an arm that silently never measured would ride the grace
+# forever. Now each entry names the bench ROUND whose artifact must
+# carry the key: the grace holds only while the newest BENCH_r*.json
+# predates that round, and the rule closes BY ITSELF the moment a
+# round-N-or-later artifact exists — measured: the claim is checked;
+# absent: the required claim is unbacked and FAILS (no manual
+# bookkeeping left to forget). serve_vs_seq_tokens entered bench.py in
+# round 6, the sp_prefill family in round 7 — each key's first artifact
+# is its round's bench run.
+PENDING_FIRST_ARTIFACT = {
+    "serve_vs_seq_tokens": 6,
+    "sp_prefill_vs_ring": 7,
+    "sp_prefill_vs_xla": 7,
+}
+
+
+def _artifact_round(label) -> int:
+    """Round number of an artifact label ('BENCH_r06.json' -> 6);
+    0 when unparsable (BASELINE.json: predates every round)."""
+    m = re.search(r"BENCH_r(\d+)", label or "")
+    return int(m.group(1)) if m else 0
 
 FLOAT_TOL = 0.005  # slack for exact-value claims (rounding in the JSON)
 
@@ -190,10 +209,12 @@ def check(repo: str = _REPO, verbose: bool = False) -> int:
                     f"{rel}: claims {key} in [{lo}, {hi}] but {src} "
                     f"measured {got}")
         elif label is not None and key in required_keys:
-            if key in PENDING_FIRST_ARTIFACT:
+            first_round = PENDING_FIRST_ARTIFACT.get(key)
+            if (first_round is not None
+                    and _artifact_round(label) < first_round):
                 print(f"check_perf_claims: {rel}: {key!r} awaits its "
-                      "first bench artifact (PENDING_FIRST_ARTIFACT)",
-                      file=sys.stderr)
+                      f"first bench artifact (round >= {first_round}; "
+                      f"newest is {label})", file=sys.stderr)
             else:
                 # fail CLOSED: a load-bearing claim no artifact (current
                 # or prior) backs is exactly the silent detachment this

@@ -79,6 +79,34 @@ def _a2a_swapped_sems(n, q=2):
                  swap_sems=True)
 
 
+@_v.mutant("fp_dropped_seg_wait", expect=_v.RACE,
+           doc="flash-prefill consumer folds a gather slot after the "
+               "LOCAL send completes instead of waiting the segment's "
+               "delivery slots — the fold reads race the in-flight "
+               "remote KV writes (the per-segment gate dropped)")
+def _fp_dropped_seg_wait(n):
+    from triton_dist_tpu.kernels.low_latency_allgather import (
+        segment_collect_start,
+    )
+
+    k, v = _v.ref("k"), _v.ref("v")
+    kbuf, vbuf = _v.ref("kbuf"), _v.ref("vbuf")
+    send, seg = _v.sem("send_sem"), _v.sem("seg_sems")
+    shmem.barrier_all(_AXIS)
+    handles = segment_collect_start(
+        lambda t_i, i: (kbuf, vbuf)[t_i].at(i - 1),
+        (k.at(), v.at()), send.at(),
+        lambda t_i, i: seg.at(t_i, i - 1), _AXIS, n,
+    )
+    _v.read(k.at())
+    _v.read(v.at())
+    for i in range(1, n):
+        for h in handles[i]:
+            h.wait_send()  # delivery wait DROPPED (send != arrival)
+        _v.read(kbuf.at(i - 1))
+        _v.read(vbuf.at(i - 1))
+
+
 @_v.mutant("rs_ring_no_credit", expect=_v.RACE,
            doc="RS ring with the credit flow control removed: symmetric "
                "acc-slot reuse without discharge — a fast upstream "

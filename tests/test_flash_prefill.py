@@ -1,0 +1,302 @@
+"""SP flash-prefill tests: the per-segment-semaphore Pallas consumer
+(ISSUE 7) vs its oracles.
+
+Contract under test (kernels/flash_prefill.py module doc):
+  - the local kernel == dense/blockwise gqa_attention (allclose — the
+    online softmax re-associates the reductions, so dense-softmax BIT
+    parity is not a meaningful target);
+  - the distributed kernel is BIT-IDENTICAL to flash_prefill_ref, the
+    same swizzle-order fold over an XLA-gathered KV: the per-segment
+    delivery-semaphore transport moves bytes, never bits (the PR-2/PR-6
+    bit-identity discipline applied to the overlap protocol itself);
+  - under an injected straggler the per-segment sem_wait spans make the
+    skew ATTRIBUTABLE (trace.fp_seg_waits delivery replay);
+  - tracing off: unchanged pallas_call_count, bitwise-unchanged output.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.flash_prefill import (
+    flash_prefill_local,
+    flash_prefill_ref,
+    sp_flash_prefill,
+    sp_prefill_attention,
+)
+from triton_dist_tpu.kernels.sp_attention import (
+    ring_attention,
+    ring_attention_ref,
+)
+from triton_dist_tpu.lang.core import pallas_call_count
+from triton_dist_tpu.layers.attention import gqa_attention
+from triton_dist_tpu.runtime import make_mesh
+
+N_DEV = 8
+
+
+def _rand(rng, shape, dtype=jnp.float32, scale=0.5):
+    return jnp.asarray(rng.standard_normal(shape) * scale, dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_prefill_local_matches_gqa(causal):
+    """Local kernel vs the dense oracle: GQA G>1, ragged kv_len, page
+    streaming (several KV blocks), offset q_positions (the serve
+    prefill-into-cache form)."""
+    rng = np.random.default_rng(0)
+    b, s, t, hq, hkv, d = 3, 16, 64, 4, 2, 16
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, t, hkv, d))
+    v = _rand(rng, (b, t, hkv, d))
+    kv_len = jnp.asarray([37, 0, 64])  # mid-page, empty, full
+    qpos = jnp.tile(jnp.arange(s)[None] + 7, (b, 1))
+    got = jax.jit(functools.partial(
+        flash_prefill_local, q_positions=qpos, kv_len=kv_len,
+        causal=causal, block=16))(q, k, v)
+    want = gqa_attention(q, k, v, causal=causal, q_positions=qpos,
+                         kv_len=kv_len)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_prefill_local_pads_ragged_t():
+    """T not divisible by the block: the kernel pads and masks — same
+    result as the unpadded oracle."""
+    rng = np.random.default_rng(1)
+    b, s, t, hq, hkv, d = 1, 8, 23, 2, 1, 16
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, t, hkv, d))
+    v = _rand(rng, (b, t, hkv, d))
+    got = jax.jit(functools.partial(flash_prefill_local, block=8))(
+        q, k, v)
+    want = gqa_attention(q, k, v, causal=True, kv_len=jnp.full((b,), t))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _run_sp(fn, mesh, q, k, v, out_specs=None):
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "tp"), P(None, "tp"), P(None, "tp")),
+        out_specs=out_specs or P(None, "tp"), check_vma=False,
+    ))(q, k, v)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_sp_flash_prefill_bitwise_vs_plain_transport(n):
+    """The overlapped per-segment-semaphore kernel is BIT-IDENTICAL to
+    flash_prefill_ref (XLA gather + the same swizzle-order fold) at
+    n=2/4/8 — the protocol moves bytes, never bits."""
+    mesh = make_mesh(mesh_shape=(n,), axis_names=("tp",))
+    rng = np.random.default_rng(2)
+    b, hq, hkv, d = 2, 4, 2, 16
+    s = n * 16  # 2 KV pages per segment at block=8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+    kv_len = jnp.asarray([s - 3, s // 2])
+    got = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    kv_len=kv_len, block=8),
+                  mesh, q, k, v)
+    want = _run_sp(functools.partial(flash_prefill_ref, axis="tp",
+                                     kv_len=kv_len, block=8),
+                   mesh, q, k, v)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sp_flash_prefill_bitwise_world1_nondividing_block():
+    """n=1 with a block that does NOT divide S_loc: the world=1 path
+    must re-fit to the divisor rule (not pad) so it stays bit-identical
+    to flash_prefill_ref — the regression the third review pass
+    caught."""
+    mesh = make_mesh(mesh_shape=(1,), axis_names=("tp",))
+    rng = np.random.default_rng(11)
+    b, s, hq, hkv, d = 1, 24, 2, 1, 16
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+    got = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    block=16), mesh, q, k, v)
+    want = _run_sp(functools.partial(flash_prefill_ref, axis="tp",
+                                     block=16), mesh, q, k, v)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_sp_flash_prefill_matches_oracle(n):
+    """Against the gather-everything dense oracle (ring_attention_ref):
+    causal + ragged varlen batches + GQA G>1, at n=2 and n=4."""
+    mesh = make_mesh(mesh_shape=(n,), axis_names=("tp",))
+    rng = np.random.default_rng(3)
+    b, hq, hkv, d = 3, 4, 2, 16
+    s = n * 8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+    kv_len = jnp.asarray([s - 3, 5, s])
+    got = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    kv_len=kv_len, block=8),
+                  mesh, q, k, v)
+    want = _run_sp(functools.partial(ring_attention_ref, axis="tp",
+                                     causal=True, kv_len=kv_len),
+                   mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_flash_prefill_noncausal(mesh8):
+    rng = np.random.default_rng(4)
+    b, hq, hkv, d = 1, 2, 1, 16
+    s = N_DEV * 8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+    got = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    causal=False, block=8),
+                  mesh8, q, k, v)
+    want = _run_sp(functools.partial(ring_attention_ref, axis="tp",
+                                     causal=False),
+                   mesh8, q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_prefill_attention_switch(mesh8):
+    """The autotuner-selectable switch: "ring" == ring_attention
+    bitwise, "flash" == sp_flash_prefill bitwise, "auto" on the CPU
+    interpreter resolves to the ring fallback (native shape gate)."""
+    rng = np.random.default_rng(5)
+    b, hq, hkv, d = 1, 2, 1, 16
+    s = N_DEV * 8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+
+    ring = _run_sp(functools.partial(ring_attention, axis="tp"),
+                   mesh8, q, k, v)
+    sw_ring = _run_sp(functools.partial(sp_prefill_attention, axis="tp",
+                                        impl="ring"), mesh8, q, k, v)
+    np.testing.assert_array_equal(np.asarray(sw_ring), np.asarray(ring))
+
+    flash = _run_sp(functools.partial(sp_flash_prefill, axis="tp"),
+                    mesh8, q, k, v)
+    sw_flash = _run_sp(functools.partial(sp_prefill_attention,
+                                         axis="tp", impl="flash"),
+                       mesh8, q, k, v)
+    np.testing.assert_array_equal(np.asarray(sw_flash),
+                                  np.asarray(flash))
+
+    # interpret mode: auto must take the always-available fallback
+    auto = _run_sp(functools.partial(sp_prefill_attention, axis="tp",
+                                     impl="auto"), mesh8, q, k, v)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(ring))
+
+
+@pytest.mark.parametrize("skew_rank", [2, 5])
+def test_sp_flash_prefill_skew_visibility(mesh8, skew_rank):
+    """ISSUE-7 satellite: a traced SP flash prefill under
+    straggler_delay must make the skew attributable — every receiver's
+    dominant per-segment delivery wait lands at exactly the straggler's
+    source offset (receiver q waits on source q - i at offset i, so the
+    hot offset is (q - r) mod n), reconstructed by the
+    trace.fp_seg_waits delivery replay. Tracing + skew never change the
+    bytes."""
+    from triton_dist_tpu import trace
+
+    n = N_DEV
+    delay = 200_000
+    rng = np.random.default_rng(6)
+    b, hq, hkv, d = 1, 2, 1, 16
+    s = n * 8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+    ref = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    block=8), mesh8, q, k, v)
+
+    with trace.tracing("fp", cap=512) as (build, sess):
+        out, tbuf = _run_sp(
+            functools.partial(sp_flash_prefill, axis="tp", block=8,
+                              straggler=(skew_rank, delay)),
+            mesh8, q, k, v,
+            out_specs=(P(None, "tp"), P("tp")),
+        )
+        tl = sess.assemble({"fp": np.asarray(tbuf).reshape(
+            n, -1, trace.RECORD_WORDS)})
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # span structure: n-1 delivery waits + n folds per rank
+    for rank in range(n):
+        assert len(tl.spans_of("fp", rank=rank, region="fp.wait")) \
+            == n - 1
+        assert len(tl.spans_of("fp", rank=rank, region="fp.fold")) == n
+
+    waits = trace.fp_seg_waits(tl, "fp")
+    for rank in ((skew_rank - 1) % n, (skew_rank + 1) % n):
+        w = waits[rank]
+        hot = (rank - skew_rank) % n
+        assert int(np.argmax(w)) == hot, (
+            f"rank {rank}: dominant wait at offset {int(np.argmax(w))},"
+            f" expected the straggler's offset {hot} ({w})")
+        assert w[hot] > 0.5 * w.sum() and w[hot] > 0.9 * delay
+
+
+def test_sp_flash_prefill_zero_cost_off(mesh8):
+    """Trace off: one pallas_call, no extra outputs; trace on: one
+    pallas_call, primary output bitwise-unchanged."""
+    from triton_dist_tpu import trace
+
+    rng = np.random.default_rng(7)
+    b, hq, hkv, d = 1, 2, 1, 16
+    s = N_DEV * 8
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, s, hkv, d))
+    v = _rand(rng, (b, s, hkv, d))
+
+    assert trace.active_build() is None
+    before = pallas_call_count()
+    off = _run_sp(functools.partial(sp_flash_prefill, axis="tp",
+                                    block=8), mesh8, q, k, v)
+    off_calls = pallas_call_count() - before
+
+    with trace.building(cap=256):
+        before = pallas_call_count()
+        on, tbuf = _run_sp(
+            functools.partial(sp_flash_prefill, axis="tp", block=8),
+            mesh8, q, k, v,
+            out_specs=(P(None, "tp"), P("tp")),
+        )
+        on_calls = pallas_call_count() - before
+
+    np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+    assert off_calls == 1 and on_calls == 1
+    assert trace.active_build() is None
+
+
+def test_layer_blockwise_pallas_matches_xla():
+    """gqa_attention_blockwise impl='pallas' == impl='xla' (allclose)
+    on the layer contract — the switch the serve prefill-chunk path
+    rides (forced here: the CPU auto gate keeps interpret runs on
+    xla)."""
+    from triton_dist_tpu.layers.attention import gqa_attention_blockwise
+
+    rng = np.random.default_rng(8)
+    b, s, t, hq, hkv, d = 2, 8, 32, 4, 2, 16
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, t, hkv, d))
+    v = _rand(rng, (b, t, hkv, d))
+    kv_len = jnp.asarray([19, 32])
+    qpos = jnp.tile(jnp.arange(s)[None] + 3, (b, 1))
+    got = jax.jit(functools.partial(
+        gqa_attention_blockwise, impl="pallas", q_positions=qpos,
+        kv_len=kv_len, chunk=16))(q, k, v)
+    want = jax.jit(functools.partial(
+        gqa_attention_blockwise, impl="xla", q_positions=qpos,
+        kv_len=kv_len, chunk=16))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

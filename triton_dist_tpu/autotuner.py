@@ -371,6 +371,81 @@ def prune_gemm_rs_local_configs(m, k_loc, n_full, configs=None,
         slack, chip, top_n)
 
 
+def flash_prefill_config_space():
+    """Candidate FlashPrefillConfig grid for the SP/local flash-prefill
+    kernels (kernels/flash_prefill.py): KV page heights spanning the
+    latency (small pages start folding sooner after a segment lands) vs
+    bandwidth (tall pages amortize the per-copy overhead) trade. Every
+    candidate is re-fitted to the actual KV length by the kernel's
+    divisor rule (_kv_block-style), so the space stays valid at any
+    shape."""
+    from triton_dist_tpu.kernels.flash_prefill import FlashPrefillConfig
+
+    return [FlashPrefillConfig(block=blk) for blk in (128, 256, 512, 1024)]
+
+
+def prune_flash_prefill_configs(s_q, t, hq, hkv, d, configs=None,
+                                dtype=None, batch=1, slack=1.25,
+                                chip=None, top_n=None):
+    """Model-pruned flash-prefill candidates at one shape: keep the
+    VMEM-fitting block heights (double-buffered (block, 2*Hkv*D) pages
+    plus the per-head f32 states) on the estimate_flash_prefill_ms
+    frontier, dedupe configs that degrade to the same fitted block, cap
+    at top_n — the frontier+dedupe+top_n discipline of
+    prune_ag_gemm_configs."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.flash_prefill import FlashPrefillConfig
+    from triton_dist_tpu.perf_model import (
+        estimate_flash_prefill_ms,
+        kernel_vmem_ceiling,
+        roofline_frontier,
+    )
+
+    dtype = dtype or jnp.bfloat16
+    configs = list(configs) if configs is not None \
+        else flash_prefill_config_space()
+
+    from triton_dist_tpu.kernels.flash_prefill import (
+        fit_block,
+        flash_prefill_vmem_bytes,
+    )
+
+    def fitted(cfg):
+        # THE kernel's divisor rule (sp_flash_prefill, the ref replay
+        # and the bench arm all fit the same way), so the pruner never
+        # models a geometry the kernel would not run
+        return fit_block(t, cfg.block)
+
+    def vmem_need(cfg):
+        return flash_prefill_vmem_bytes(s_q, hq, hkv, d, fitted(cfg),
+                                        dtype)
+
+    budget = kernel_vmem_ceiling(chip)
+    live = [c for c in configs if vmem_need(c) <= budget]
+    if not live:
+        return [min(configs, key=vmem_need)]
+
+    def model_ms(cfg):
+        # block height enters through the KV page's DMA burst length
+        # (perf_model.hbm_stream_efficiency): taller pages amortize the
+        # per-burst gap, smaller pages start folding sooner after a
+        # segment lands — the model ranks the bandwidth side, the
+        # frontier slack keeps the latency side measurable
+        return estimate_flash_prefill_ms(s_q, t, hq, hkv, d, batch,
+                                         dtype, chip, block=fitted(cfg))
+
+    seen, uniq = set(), []
+    for c in roofline_frontier(live, model_ms, slack):
+        ft = fitted(c)
+        if ft not in seen:
+            seen.add(ft)
+            uniq.append(c)
+    if top_n is not None and len(uniq) > top_n:
+        uniq = sorted(uniq, key=model_ms)[:top_n]
+    return uniq
+
+
 def ep_moe_config_space():
     """Candidate EpMoeConfig grid for the chunk-pipelined EP MoE
     (kernels/ep_a2a.ep_moe_pipeline): chunk counts spanning no-pipelining

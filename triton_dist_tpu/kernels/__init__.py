@@ -97,3 +97,12 @@ from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
     flash_decode_partial,
     sp_flash_decode,
 )
+from triton_dist_tpu.kernels.flash_prefill import (  # noqa: F401
+    FlashPrefillConfig,
+    flash_prefill_local,
+    flash_prefill_native_ok,
+    flash_prefill_ref,
+    sp_flash_prefill,
+    sp_prefill_attention,
+    supports_flash_prefill,
+)

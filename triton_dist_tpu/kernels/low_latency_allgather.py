@@ -29,6 +29,7 @@ Bandwidth-class payloads want the ring/2-axis kernels in allgather.py.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Tuple
 
@@ -38,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.verify import capture as _vcap
 from triton_dist_tpu.lang.core import (
     compiler_params,
     interpret_no_headroom,
@@ -179,6 +181,53 @@ def ll_all_gather_op(
     )
     workspace.update(name, new_buf)
     return out
+
+
+# -- per-segment-signalled producer (exposed delivery semaphores) ------------
+
+
+def segment_collect_start(dst_slot_at, srcs, send_sem, seg_sem_at,
+                          axis: str, n: int, on_send=None):
+    """Full-mesh segment push with EXPOSED per-segment delivery
+    semaphores — the LL-AG producer discipline opened up for in-kernel
+    consumers (kernels/flash_prefill.py): where `fcollect_slots` counts
+    every arrival on one shared semaphore (consumable only by a full
+    wait), here each (tensor, source-offset) pair gets its OWN slot, so
+    a consumer can gate on exactly one segment's arrival while later
+    segments are still in flight — the per-segment barrier of the
+    reference's SP-AG attention (sp_ag_attention_intra_node.py:105-427)
+    carried by semaphore counting, exactly as the parity slots of
+    `_ll_ag_kernel` carry the LL flag-validation ordering.
+
+    dst_slot_at(t, i): the symmetric destination slot ref for tensor t,
+    source-offset i (1..n-1) — every rank's descriptor for offset i
+    names the same static slot, which is what both the hardware DMA and
+    the legacy interpreter's lockstep discharge require to agree (the
+    PR-2 slot rule). seg_sem_at(t, i): that slot's delivery semaphore.
+    srcs: the local tensors to push (each goes to every peer).
+    on_send(i): optional per-offset hook (trace instants).
+
+    Returns {offset: [PutHandle per tensor]}; the consumer pairs each
+    offset's `wait_recv()`s (delivery gate) with a trailing
+    `wait_send()` drain (semaphore balance). Caller must barrier the
+    team first (same precondition as fcollect). Works under
+    verify.capturing() — the flash-prefill protocol model replays this
+    exact producer."""
+    me = shmem.my_pe(axis)
+    sym = _vcap.active() is not None
+    handles = {}
+    for i in range(1, n):
+        peer = (me + i) % n if sym else jnp.mod(me + i, n)
+        if on_send is not None:
+            on_send(i)
+        ctx = _vcap.tag(step=i) if sym else contextlib.nullcontext()
+        with ctx:
+            handles[i] = [
+                shmem.putmem_nbi(dst_slot_at(t, i), src, send_sem,
+                                 seg_sem_at(t, i), peer, axis)
+                for t, src in enumerate(srcs)
+            ]
+    return handles
 
 
 # -- protocol model (static verifier, triton_dist_tpu.verify) ----------------

@@ -17,6 +17,15 @@ Memory never exceeds one KV block per step — the blockwise/ring-attention
 long-context property: sequence length scales linearly with the number of
 chips.
 
+Since ISSUE 7 this formulation is the FALLBACK arm of the SP prefill
+switch: `kernels/flash_prefill.sp_flash_prefill` applies the repo's
+thesis here too — the same fold as one Pallas kernel whose ring ingest
+waits on per-segment delivery semaphores (the reference's consumer-wait
+mechanism, no XLA scheduling trust required). `sp_prefill_attention`
+selects between them via `perf_model.choose_sp_prefill_impl`;
+ring_attention remains the always-available XLA path (interpret
+no-headroom meshes, unsupported native shapes).
+
 Layout: rank r holds Q rows and KV rows [r*S_loc, (r+1)*S_loc) of the
 global sequence (contiguous sharding).
 """
@@ -140,7 +149,9 @@ def ring_attention_ref(q, k, v, axis: str = SP_AXIS, causal: bool = True,
     k_full = jax.lax.all_gather(k, axis, axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, axis, axis=1, tiled=True)
     q_pos = me * sq + jnp.tile(jnp.arange(sq)[None], (q.shape[0], 1))
+    # prefill_impl pinned: an oracle must not auto-route into the very
+    # Pallas kernel it is the oracle FOR (native-TPU runs)
     return gqa_attention(
         q, k_full, v_full, causal=causal, q_positions=q_pos, scale=scale,
-        kv_len=kv_len,
+        kv_len=kv_len, prefill_impl="xla",
     )

@@ -7,14 +7,20 @@ flashinfer prefill/decode kernels). Two regimes:
   dense — one einsum chain; XLA fuses it and the MXU does the work. The
   (B, Hkv, G, S, T) f32 logits tensor is materialized, fine up to a few
   thousand tokens.
-  blockwise — the flash-attention form: lax.scan over KV chunks folding
-  each into the online-softmax state (the same _block_update core the
-  ring attention uses), so peak memory is O(S*chunk) instead of O(S*T).
+  blockwise — the flash-attention form: fold KV chunk-by-chunk through
+  the online softmax, so peak memory is O(S*chunk) instead of O(S*T).
   gqa_attention auto-selects it past _BLOCKWISE_T tokens (the flashinfer
-  prefill analog, ref tp_attn.py:180-253).
+  prefill analog, ref tp_attn.py:180-253). Two implementations ride the
+  same contract behind the `impl` switch: "xla" (lax.scan over
+  _block_update — each chunk's f32 logits tensor materializes between
+  the einsums) and "pallas" (kernels/flash_prefill.flash_prefill_local —
+  double-buffered KV pages, logits never leave VMEM). "auto" asks
+  perf_model.choose_prefill_impl, with the xla path as the fallback
+  whenever the kernel's native shape support does not hold.
 
-Pallas enters for the *distributed* variants (sp_attention.py,
-flash_decode.py) where per-segment semaphore waits are the point.
+Pallas also carries the *distributed* variants (sp_attention.py,
+flash_decode.py, flash_prefill.sp_flash_prefill) where per-segment
+semaphore waits are the point.
 
 Shapes (GQA): q (B, S, Hq, D), k/v (B, T, Hkv, D), Hq = G * Hkv.
 All softmax math in f32.
@@ -35,6 +41,32 @@ NEG_INF = -1e30
 _BLOCKWISE_T = 4096
 
 
+def _route_prefill_impl(b, s, t, hq, hkv, d, dtype) -> str:
+    """THE prefill-impl routing predicate ("pallas" | "xla"): native
+    gate (kernels.flash_prefill.flash_prefill_native_ok — interpret
+    stays xla for CPU bit-stability) + the perf-model pick
+    (perf_model.choose_prefill_impl). Shared by gqa_attention's auto
+    path and gqa_attention_blockwise's "auto" — one place for the
+    decision, however it is reached."""
+    from triton_dist_tpu.kernels.flash_prefill import (
+        flash_prefill_fits,
+        flash_prefill_native_ok,
+    )
+
+    if not flash_prefill_native_ok(hq, hkv, d):
+        return "xla"
+    if not flash_prefill_fits(s, t, hq, hkv, d, dtype=dtype):
+        # per-grid-step state beyond the VMEM ceiling: the blockwise
+        # xla path handles arbitrarily long context; auto must never
+        # route into a Mosaic allocation failure
+        return "xla"
+    from triton_dist_tpu.perf_model import choose_prefill_impl
+
+    return ("pallas" if choose_prefill_impl(s, t, hq, hkv, d, batch=b,
+                                            dtype=dtype) == "flash"
+            else "xla")
+
+
 def gqa_attention_blockwise(
     q,
     k,
@@ -45,13 +77,32 @@ def gqa_attention_blockwise(
     kv_len: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     chunk: int = 512,
+    impl: str = "auto",
 ):
     """Blockwise (flash) GQA prefill: same contract as gqa_attention but
     KV is folded chunk-by-chunk through the online softmax, never
     materializing the (S, T) logits (ref: the flashinfer prefill call,
-    tp_attn.py:180-253; core shared with ring_attention's _block_update).
-    """
+    tp_attn.py:180-253; xla core shared with ring_attention's
+    _block_update). impl: "xla" | "pallas" | "auto" (the module-doc
+    switch; perf_model.choose_prefill_impl)."""
     from triton_dist_tpu.kernels.sp_attention import _block_update
+
+    if impl == "auto":
+        bq, sq, hq_, dq = q.shape
+        impl = _route_prefill_impl(bq, sq, k.shape[1], hq_, k.shape[2],
+                                   dq, k.dtype)
+    if impl == "pallas":
+        from triton_dist_tpu.kernels.flash_prefill import (
+            flash_prefill_local,
+        )
+
+        # `chunk` IS the kernel's KV page height — the tuning knob of
+        # the shared contract must steer both implementations
+        return flash_prefill_local(
+            q, k, v, q_positions=q_positions, q_offset=q_offset,
+            kv_len=kv_len, causal=causal, scale=scale, block=chunk,
+        )
+    assert impl == "xla", f"unknown blockwise impl {impl!r}"
 
     b, s, hq, d = q.shape
     _, t, hkv, _ = k.shape
@@ -110,6 +161,7 @@ def gqa_attention(
     q_positions: Optional[jnp.ndarray] = None,
     kv_len: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
+    prefill_impl: Optional[str] = None,
 ):
     """Grouped-query attention forward.
 
@@ -117,17 +169,35 @@ def gqa_attention(
     cache length). q_positions: (B, S) absolute positions of the q rows —
     the general form (prefill-into-cache, per-batch offsets); overrides
     q_offset. kv_len: optional valid KV prefix length (masks the
-    preallocated cache tail). Returns (B, S, Hq, D) in q.dtype.
+    preallocated cache tail). prefill_impl: force the multi-token
+    prefill implementation ("xla" | "pallas" — the serve prefill-chunk
+    switch; None = auto routing: the Pallas flash kernel whenever the
+    native gate + perf model pick it, the blockwise scan past
+    _BLOCKWISE_T, the dense einsum chain otherwise). Returns
+    (B, S, Hq, D) in q.dtype.
     """
     b, s, hq, d = q.shape
     _, t, hkv, _ = k.shape
-    if s > 1 and t >= _BLOCKWISE_T:
-        # long-context prefill: O(S*chunk) blockwise path (decode s==1
-        # stays dense — its "logits" are one row)
-        return gqa_attention_blockwise(
-            q, k, v, causal=causal, q_offset=q_offset,
-            q_positions=q_positions, kv_len=kv_len, scale=scale,
-        )
+    if s > 1:
+        impl = (prefill_impl if prefill_impl is not None
+                else _route_prefill_impl(b, s, t, hq, hkv, d, k.dtype))
+        if impl == "pallas":
+            # serve prefill-chunk / native prefill: the Pallas kernel
+            # beats the dense chain as soon as the f32 logits tensor
+            # is the dominant HBM term (perf_model prices both)
+            return gqa_attention_blockwise(
+                q, k, v, causal=causal, q_offset=q_offset,
+                q_positions=q_positions, kv_len=kv_len, scale=scale,
+                impl="pallas",
+            )
+        if t >= _BLOCKWISE_T:
+            # long-context prefill: O(S*chunk) blockwise path (decode
+            # s==1 stays dense — its "logits" are one row)
+            return gqa_attention_blockwise(
+                q, k, v, causal=causal, q_offset=q_offset,
+                q_positions=q_positions, kv_len=kv_len, scale=scale,
+                impl="xla",
+            )
     g = hq // hkv
     scale = scale if scale is not None else d ** -0.5
 

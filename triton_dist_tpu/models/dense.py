@@ -172,7 +172,8 @@ def init_params(
 
 
 def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
-               kv_len, batch, axis, mode, x, lp: DenseLayerParams, kv):
+               kv_len, batch, axis, mode, attn_impl, x,
+               lp: DenseLayerParams, kv):
     """One transformer block (ref DenseLLMLayer.fwd, dense.py:101-114)."""
     attn_params = TPAttnParams(
         w_qkv=lp.w_qkv, w_o=lp.w_o,
@@ -183,6 +184,7 @@ def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
     attn_out, kv = tp_attn_fwd(
         h, attn_params, spec, cos, sin, positions, batch,
         axis=axis, mode=mode, kv_cache=kv, kv_len=kv_len,
+        attn_impl=attn_impl,
     )
     x = x + attn_out
     h = rms_norm(x, lp.post_attn_ln, cfg.rms_eps)
@@ -210,10 +212,13 @@ def forward(
     mode: str = "dist",
     axis: str = TP_AXIS,
     return_full_logits: bool = False,
+    attn_impl: Optional[str] = None,
 ):
     """Per-device forward (inside shard_map). Returns (logits, new_cache);
     logits (B, V) for the last position (or (B, S, V) if
-    return_full_logits). Mirrors the reference inference entry
+    return_full_logits). attn_impl: prefill attention implementation
+    override ("xla" | "pallas"; None = auto — the flash-prefill switch,
+    layers/attention.py). Mirrors the reference inference entry
     (ref: models/dense.py:221-241 `inference`)."""
     if cache is None:
         raise ValueError("forward requires a KVCache (create one per serve)")
@@ -242,7 +247,7 @@ def forward(
     def step(x, xs):
         lp, k_l, v_l = xs
         x, kv = _layer_fwd(cfg, spec, cos, sin, positions, kv_len, b,
-                           axis, mode, x, lp, (k_l, v_l))
+                           axis, mode, attn_impl, x, lp, (k_l, v_l))
         return x, kv
 
     # strip the n-axis dim (shard_map gives size-1 shards on that dim)

@@ -517,6 +517,160 @@ def mega_decode_floor_ms(*args, chip: Optional[ChipSpec] = None,
         mega_decode_traffic_terms(*args, **kwargs), chip)
 
 
+# -- SP flash-prefill pipeline model (ISSUE 7 tentpole) ----------------------
+
+# Fixed cost of dispatching the Pallas prefill kernel (launch + scalar
+# prologue + the first page's un-overlapped DMA). The XLA formulations
+# fuse into the surrounding program and pay no such step, so this term
+# is what makes choose_prefill_impl a real decision: tiny serve chunks
+# (s*t small — logits traffic below a few MB) stay on the fused dense
+# path; the kernel wins as soon as the logits term clears it.
+FLASH_PREFILL_LAUNCH_US = 5.0
+
+
+def estimate_flash_prefill_ms(
+    s_q: int,
+    t: int,
+    hq: int,
+    hkv: int,
+    d: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    block: Optional[int] = None,
+) -> float:
+    """Roofline of ONE flash-prefill fold sweep: s_q query rows against
+    t KV rows (kernels/flash_prefill._fp_local_kernel, or one segment
+    of the SP kernel with t = S_loc). Compute is the per-block flash
+    FLOPs (4*S*T*Hq*D — logits + p@v, online state updates are noise);
+    memory is the double-buffered KV page stream at the page's burst
+    efficiency (`block` rows x Hkv*D columns contiguous — taller pages
+    amortize the per-burst gap, the trade the autotuner's pruner
+    ranks); plus the fixed kernel-dispatch term the fused XLA paths do
+    not pay. The (S, T) logits tensor never exists, which is exactly
+    the term that separates this from estimate_xla_prefill_ms."""
+    chip = chip or detect_chip()
+    b = _dtype_bytes(dtype)
+    flops = 4.0 * batch * s_q * t * hq * d
+    compute_ms = flops / (
+        chip.bf16_tflops * 1e12 * 0.85 * mxu_efficiency(s_q, t, d)
+    ) * 1e3
+    kv_bytes = 2 * batch * t * hkv * d * b
+    burst = block * hkv * d * b if block else None
+    mem_ms = kv_bytes / (
+        chip.hbm_gbps * 1e9 * hbm_stream_efficiency(burst)) * 1e3
+    return max(compute_ms, mem_ms) + FLASH_PREFILL_LAUNCH_US * 1e-3
+
+
+def estimate_xla_prefill_ms(
+    s_q: int,
+    t: int,
+    hq: int,
+    hkv: int,
+    d: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+) -> float:
+    """The XLA fold (ring_attention's _block_update / the blockwise
+    scan): same FLOPs, but the f32 logits materialize in HBM between
+    the two einsums — written by the first einsum's fusion and read
+    back by the softmax/p@v fusion. The TOTAL is s_q*t regardless of
+    how the sweep is chunked (chunk-invariant, hence no chunk knob
+    here). That traffic rides in its OWN phases, serialized against
+    the MXU work (separate fusions — XLA does not flash-rewrite
+    attention), so it ADDS to the roofline rather than hiding under
+    it. That additive term is what the Pallas kernel deletes."""
+    chip = chip or detect_chip()
+    b = _dtype_bytes(dtype)
+    flops = 4.0 * batch * s_q * t * hq * d
+    compute_ms = flops / (
+        chip.bf16_tflops * 1e12 * 0.85 * mxu_efficiency(s_q, t, d)
+    ) * 1e3
+    kv_bytes = 2 * batch * t * hkv * d * b
+    logits_bytes = 2 * 4 * batch * hq * s_q * t  # f32, write + read
+    logits_ms = logits_bytes / (chip.hbm_gbps * 1e9) * 1e3
+    mem_ms = kv_bytes / (chip.hbm_gbps * 1e9) * 1e3
+    return max(compute_ms, mem_ms) + logits_ms
+
+
+def choose_prefill_impl(
+    s_q: int,
+    t: int,
+    hq: int,
+    hkv: int,
+    d: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+) -> str:
+    """"flash" | "xla" for a LOCAL prefill sweep (the serve prefill-
+    chunk / blockwise-prefill switch, layers.attention.gqa_attention).
+    Shape support (native lane alignment) is the caller's gate
+    (kernels.flash_prefill.supports_flash_prefill); this ranks cost
+    only."""
+    f = estimate_flash_prefill_ms(s_q, t, hq, hkv, d, batch, dtype, chip)
+    x = estimate_xla_prefill_ms(s_q, t, hq, hkv, d, batch, dtype, chip)
+    return "flash" if f <= x else "xla"
+
+
+def estimate_sp_prefill_ms(
+    s_loc: int,
+    n: int,
+    hq: int,
+    hkv: int,
+    d: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    impl: str = "flash",
+) -> float:
+    """Pipeline roofline of the SP flash prefill
+    (kernels/flash_prefill._fp_sp_kernel): per-segment ICI delivery vs
+    per-segment flash fold, exposed = ramp + (n-1)*max(seg_ms, fold_ms)
+    where ramp is the zero-wait LOCAL fold (the rank-offset swizzle —
+    the first remote segment flies while it runs) and every remaining
+    segment costs whichever of its delivery or its fold dominates.
+
+    impl="ring" prices the lax.ppermute formulation instead: the XLA
+    fold (logits materialization, estimate_xla_prefill_ms) per segment,
+    with the same overlap structure credited to XLA's async collectives
+    — the model separates the two by the fold term, not by distrusting
+    XLA's overlap. Ranks candidates for choose_sp_prefill_impl /
+    autotuner.prune_flash_prefill_configs; does not promise wall-clock."""
+    chip = chip or detect_chip()
+    b = _dtype_bytes(dtype)
+    est = (estimate_flash_prefill_ms if impl == "flash"
+           else estimate_xla_prefill_ms)
+    fold_ms = est(s_loc, s_loc, hq, hkv, d, batch, dtype, chip)
+    if n <= 1:
+        return fold_ms
+    seg_bytes = 2 * batch * s_loc * hkv * d * b
+    seg_ms = seg_bytes / (ici_ring_bw_gbps(chip) * 1e9) * 1e3 \
+        + chip.ici_latency_us * 1e-3
+    return fold_ms + (n - 1) * max(seg_ms, fold_ms)
+
+
+def choose_sp_prefill_impl(
+    s_loc: int,
+    n: int,
+    hq: int,
+    hkv: int,
+    d: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+) -> str:
+    """"flash" | "ring" — the autotuner-selectable SP prefill switch
+    (kernels.flash_prefill.sp_prefill_attention). ring_attention stays
+    the fallback whenever the model does not rank the kernel ahead."""
+    f = estimate_sp_prefill_ms(s_loc, n, hq, hkv, d, batch, dtype, chip,
+                               impl="flash")
+    r = estimate_sp_prefill_ms(s_loc, n, hq, hkv, d, batch, dtype, chip,
+                               impl="ring")
+    return "flash" if f <= r else "ring"
+
+
 # -- serving-plane step model (ISSUE 6 tentpole (c)) -------------------------
 
 
@@ -532,6 +686,7 @@ def estimate_serve_step_ms(
     kv_tokens: int = 0,
     dtype=jnp.bfloat16,
     chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
 ) -> float:
     """Roofline of ONE mixed prefill+decode serve step
     (models/engine.make_serve_step) processing `n_tokens` real tokens
@@ -544,8 +699,15 @@ def estimate_serve_step_ms(
     tokens ride the step, so packing prefill chunks beside decode slots
     amortizes it; the COMPUTE term grows with n_tokens and eventually
     flips the step compute-bound — the crossover the chunk chooser
-    walks. KV/activation traffic ride along as minor terms. Ranks
-    scheduler choices; does not promise wall-clock."""
+    walks. KV/activation traffic ride along as minor terms.
+
+    attn_impl prices the prefill-chunk attention: "flash" (the Pallas
+    flash-prefill kernel — KV stream only) vs "xla" (the dense/scan
+    formulation, which also writes+reads the f32 logits chunk). Bigger
+    chunks grow the xla logits term quadratically, so the chooser's
+    pick widens under "flash" — exactly the effect the device-side
+    kernel buys the scheduler. Ranks scheduler choices; does not
+    promise wall-clock."""
     chip = chip or detect_chip()
     b = _dtype_bytes(dtype)
     hqd, kwd = hq_loc * head_dim, hkv_loc * head_dim
@@ -557,13 +719,16 @@ def estimate_serve_step_ms(
     ) * b + hidden * vocab_loc * b    # lm_head
     kv_bytes = 2 * num_layers * kwd * kv_tokens * b
     act_bytes = n_tokens * num_layers * (4 * hidden + 3 * inter_loc) * b
+    if attn_impl == "xla":
+        # per-layer f32 logits chunk materializes (write + read)
+        act_bytes += num_layers * 2 * 4 * hq_loc * n_tokens * kv_tokens
     mem_ms = (w_bytes + kv_bytes + act_bytes) / (chip.hbm_gbps * 1e9) * 1e3
 
     flops = 2.0 * n_tokens * (
         num_layers * (hidden * (hqd + 2 * kwd) + hqd * hidden
                       + 3 * hidden * inter_loc)
         + hidden * vocab_loc
-    )
+    ) + 4.0 * n_tokens * kv_tokens * num_layers * hq_loc * head_dim
     # efficiency WITHOUT the short-m penalty: at small token counts the
     # step is weight-stream-bound and the MXU consumes rows as they
     # arrive (the measured decode step sits on the HBM floor, not a
@@ -591,6 +756,7 @@ def choose_prefill_chunk(
     chip: Optional[ChipSpec] = None,
     stall_budget: float = 2.0,
     candidates=(1, 2, 4, 8, 16, 32, 64, 128),
+    attn_impl: str = "flash",
 ) -> int:
     """Model-guided prefill chunk size for the Scheduler: the largest
     candidate whose mixed step (one slot prefilling `chunk` tokens, the
@@ -600,17 +766,21 @@ def choose_prefill_chunk(
     token (TPOT), so the budget caps the decode stall a prefill may
     inject. While the step is weight-stream-bound the marginal chunk
     column is nearly free and the pick is large; once compute-bound the
-    pick clamps. Returns at least candidates[0]."""
+    pick clamps. `attn_impl` prices the chunk's attention (see
+    estimate_serve_step_ms — the flash kernel's missing logits term is
+    what lets the pick stay wide at long contexts). Returns at least
+    candidates[0]."""
     args = (num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
             vocab_loc)
     base = estimate_serve_step_ms(*args, n_tokens=max(slots, 1),
                                   kv_tokens=kv_tokens, dtype=dtype,
-                                  chip=chip)
+                                  chip=chip, attn_impl=attn_impl)
     best = candidates[0]
     for c in sorted(candidates):
         mixed = estimate_serve_step_ms(
             *args, n_tokens=c + max(slots - 1, 0),
-            kv_tokens=kv_tokens, dtype=dtype, chip=chip)
+            kv_tokens=kv_tokens, dtype=dtype, chip=chip,
+            attn_impl=attn_impl)
         if mixed <= stall_budget * base:
             best = c
     return best

@@ -73,16 +73,27 @@ class Scheduler:
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
                            total_pages=total_pages)
         if chunk is None:
+            from triton_dist_tpu.kernels.flash_prefill import (
+                flash_prefill_native_ok,
+            )
             from triton_dist_tpu.perf_model import choose_prefill_chunk
 
             cfg = engine.cfg
             n = int(engine.mesh.shape[engine.axis])
+            # price the chunk's attention at the impl the step will
+            # actually run (the flash-prefill switch, layers/attention):
+            # the kernel's missing f32-logits term keeps the pick wide
+            attn_impl = (
+                "flash" if flash_prefill_native_ok(
+                    cfg.num_q_heads // n, cfg.num_kv_heads // n,
+                    cfg.head_dim) else "xla")
             chunk = choose_prefill_chunk(
                 cfg.num_layers, cfg.hidden_size,
                 cfg.intermediate_size // n, cfg.num_q_heads // n,
                 cfg.num_kv_heads // n, cfg.head_dim,
                 cfg.vocab_size // n, slots=slots,
                 kv_tokens=self.pool.t_max, dtype=cfg.dtype,
+                attn_impl=attn_impl,
             )
             chunk = max(1, min(chunk, self.pool.t_max))
         self.chunk = chunk

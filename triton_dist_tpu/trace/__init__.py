@@ -59,6 +59,7 @@ from triton_dist_tpu.trace.attribution import (  # noqa: F401
     classify,
     compare_predicted,
     format_table,
+    fp_seg_waits,
     per_region,
     prefetch_hit_rate,
     task_time_by_branch,

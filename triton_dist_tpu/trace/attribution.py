@@ -111,15 +111,16 @@ def format_table(tl: Timeline) -> str:
 # -- chunked-A2A delivery replay ---------------------------------------------
 
 
-def a2a_step_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
-    """Per receiver rank: reconstructed delivery wait per ring step.
-
-    Replays the kernel's chunk-major wait order: for each receiver-side
-    "a2a.wait" span (payload=step i, aux=chunk c), arrival is the
-    matching sender-side "a2a.send" instant on rank (q - i) mod n; the
+def _delivery_replay(tl: Timeline, stream: str, send_region: str,
+                     wait_region: str) -> Dict[int, np.ndarray]:
+    """Shared delivery-wait reconstruction over a (send instant, wait
+    span) region pair whose payload is the ring step / source offset:
+    for each receiver-side wait span (payload=i, aux=sub-unit), arrival
+    is the matching sender-side send instant on rank (q - i) mod n; the
     consumer cursor advances through max(ready, arrival), and the
-    blocked amount accrues to step i. Step 0 (the local segment) never
-    waits on a peer and reports 0."""
+    blocked amount accrues to offset i. Offset 0 (the local segment)
+    never waits on a peer and reports 0. Used by the chunked-A2A replay
+    and the flash-prefill per-segment replay."""
     ranks = tl.ranks(stream)
     n = len(ranks)
     if n == 0:
@@ -127,14 +128,14 @@ def a2a_step_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
     sends: Dict[tuple, float] = {}
     for e in tl.events:
         if (e.stream == stream and e.kind == ev.KIND_INSTANT
-                and e.region == ev.REGIONS["a2a.send"]):
+                and e.region == ev.REGIONS[send_region]):
             sends[(e.rank, e.payload, e.aux)] = e.t
     out: Dict[int, np.ndarray] = {}
     for q in ranks:
         waits = np.zeros(n, np.float64)
         cursor = 0.0
         spans = sorted(
-            tl.spans_of(stream, rank=q, region="a2a.wait"),
+            tl.spans_of(stream, rank=q, region=wait_region),
             key=lambda s: s.t0,
         )
         for s in spans:
@@ -150,6 +151,20 @@ def a2a_step_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
             cursor = max(start, arrival)
         out[q] = waits
     return out
+
+
+def a2a_step_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
+    """Per receiver rank: reconstructed chunked-A2A delivery wait per
+    ring step (see _delivery_replay; payload=step, aux=chunk)."""
+    return _delivery_replay(tl, stream, "a2a.send", "a2a.wait")
+
+
+def fp_seg_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
+    """Per receiver rank: reconstructed flash-prefill per-SEGMENT
+    delivery wait (payload=source offset) — where prefill time goes
+    when a producer straggles (see _delivery_replay; the SP flash
+    kernel's fp.send/fp.wait records, kernels/flash_prefill.py)."""
+    return _delivery_replay(tl, stream, "fp.send", "fp.wait")
 
 
 # -- megakernel measured-vs-predicted ----------------------------------------

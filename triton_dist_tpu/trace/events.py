@@ -85,6 +85,9 @@ REGIONS = {
     "ep.phase": 15,      # pipeline phase mark (payload=phase code)
     "ep.ffn_chunk": 16,  # per-chunk grouped FFN (payload=chunk)
     "host": 17,          # host-side python span (collect.TraceSession)
+    "fp.send": 18,       # flash-prefill segment DMA issued (payload=offset)
+    "fp.wait": 19,       # flash-prefill segment delivery wait (payload=offset)
+    "fp.fold": 20,       # flash-prefill per-segment fold (payload=offset)
 }
 _REGION_NAMES = {v: k for k, v in REGIONS.items()}
 
@@ -102,6 +105,8 @@ REGION_CLASS = {
     "mega.task": "compute",
     "mega.sb_wait": "sem_wait",
     "ep.ffn_chunk": "compute",
+    "fp.wait": "sem_wait",
+    "fp.fold": "compute",
 }
 
 # ep.phase payload codes
@@ -121,6 +126,7 @@ VERIFY_OP_REGIONS = {
     "all_to_all_chunked": {"put": "a2a.send", "wait_recv": "a2a.wait"},
     "allgather_gemm": {"wait_recv": "ag.ring_wait"},
     "gemm_reduce_scatter": {"wait": "rs.credit", "wait_recv": "rs.hop"},
+    "flash_prefill": {"put": "fp.send", "wait_recv": "fp.wait"},
 }
 
 

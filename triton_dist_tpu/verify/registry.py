@@ -36,6 +36,7 @@ _PROTOCOL_MODULES = (
     "triton_dist_tpu.kernels.gemm_reduce_scatter",
     "triton_dist_tpu.kernels.allreduce",
     "triton_dist_tpu.kernels.low_latency_allgather",
+    "triton_dist_tpu.kernels.flash_prefill",
     "triton_dist_tpu.kernels.p2p",
 )
 
