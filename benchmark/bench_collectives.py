@@ -31,6 +31,7 @@ from triton_dist_tpu.kernels import (                          # noqa: E402
 from triton_dist_tpu.perf_model import (                       # noqa: E402
     estimate_ag_ms,
     estimate_ar_ms,
+    estimate_collective_wire_ms,
     estimate_rs_ms,
 )
 from triton_dist_tpu.runtime.utils import chain_timer          # noqa: E402
@@ -96,6 +97,32 @@ def main():
                  s.astype(jnp.bfloat16), "tp",
                  accum_dtype=jnp.float32).astype(s.dtype),
              estimate_rs_ms(nbytes, n)),
+            # quantized-wire variants (ISSUE 9): the block-scaled wire
+            # image at 1 byte/element + scales — the bytes-by-precision
+            # column beside the f32-accumulation one above (the two are
+            # orthogonal knobs; see docs/performance.md "Quantized
+            # wire"). Accuracy column: wire.numerics.drift_table.
+            ("reduce_scatter", "ring_fp8wire",
+             lambda s: ring_reduce_scatter(s, "tp", wire_format="fp8"),
+             estimate_collective_wire_ms("reduce_scatter",
+                                         nbytes, n,
+                                         jnp.float32, "fp8")),
+            ("allgather", "ring_fp8wire",
+             lambda s: ring_all_gather(s, "tp", wire_format="fp8"),
+             estimate_collective_wire_ms("allgather", nbytes, n,
+                                         jnp.float32, "fp8")),
+            ("allreduce", "two_shot_fp8wire",
+             lambda s: all_reduce(s, "tp",
+                                  method=AllReduceMethod.TwoShot,
+                                  wire_format="fp8"),
+             estimate_collective_wire_ms("allreduce", nbytes, n,
+                                         jnp.float32, "fp8")),
+            ("allreduce", "two_shot_int8wire",
+             lambda s: all_reduce(s, "tp",
+                                  method=AllReduceMethod.TwoShot,
+                                  wire_format="int8"),
+             estimate_collective_wire_ms("allreduce", nbytes, n,
+                                         jnp.float32, "int8")),
             ("allreduce", "one_shot",
              lambda s: all_reduce(s, "tp",
                                   method=AllReduceMethod.OneShot),

@@ -69,6 +69,10 @@ REQUIRED_CLAIMS = (
     ("sp_prefill_vs_ring", "triton_dist_tpu/kernels/flash_prefill.py"),
     ("sp_prefill_vs_ring", "docs/performance.md"),
     ("sp_prefill_vs_xla", "docs/performance.md"),
+    ("allreduce_wire_fp8_vs_native",
+     "triton_dist_tpu/kernels/allreduce.py"),
+    ("allreduce_wire_fp8_vs_native", "docs/performance.md"),
+    ("ag_gemm_wire_fp8_vs_native", "docs/performance.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still in
@@ -86,6 +90,9 @@ PENDING_FIRST_ARTIFACT = {
     "serve_vs_seq_tokens": 6,
     "sp_prefill_vs_ring": 7,
     "sp_prefill_vs_xla": 7,
+    # quantized-wire family entered bench.py in round 8 (ISSUE 9)
+    "allreduce_wire_fp8_vs_native": 8,
+    "ag_gemm_wire_fp8_vs_native": 8,
 }
 
 

@@ -65,7 +65,17 @@ def check_shipped(names=None, verbose=False) -> int:
         for f in fs:
             print(f"  {f}")
         bad += len(fs)
-    print(f"verify_kernels: {len(reg)} protocol(s), {bad} finding(s)")
+    # quantized-wire invariant: every format-parameterized protocol's
+    # synchronization skeleton must be identical across its wire
+    # formats (docs/verification.md "Format invariance")
+    inv = registry.check_format_invariance(names or None)
+    for p in inv:
+        print(f"  [format-invariance] {p}")
+    bad += len(inv)
+    n_fmt = len([k for k in registry.format_parameterized()
+                 if not names or k in names])
+    print(f"verify_kernels: {len(reg)} protocol(s), {bad} finding(s); "
+          f"format invariance over {n_fmt} wire protocol(s)")
     return 1 if bad else 0
 
 

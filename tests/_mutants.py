@@ -107,6 +107,43 @@ def _fp_dropped_seg_wait(n):
         _v.read(vbuf.at(i - 1))
 
 
+@_v.mutant("wire_scale_no_gate", expect=_v.RACE,
+           doc="quantized-wire gather whose scale row travels as a "
+               "SEPARATE put without its delivery-semaphore gate: the "
+               "payload is properly gated but the consumer dequantizes "
+               "with a scale that may not have landed (wait_send on the "
+               "scale put is a LOCAL send completion, not arrival) — "
+               "the defect class the wire codec's single-image design "
+               "(scales bitcast INTO the payload rows, one put, one "
+               "delivery semaphore) exists to make unrepresentable")
+def _wire_scale_no_gate(n):
+    me = shmem.my_pe(_AXIS)
+    x, sc = _v.ref("x"), _v.ref("scales")
+    o, so = _v.ref("out"), _v.ref("scales_out")
+    lsem = _v.sem("local_sem")
+    send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+    s_send, s_recv = _v.sem("sc_send_sem"), _v.sem("sc_recv_sem")
+    shmem.barrier_all(_AXIS)
+    lp = _v.copy(o.at(me), x.at(), lsem.at())
+    ls = _v.copy(so.at(me), sc.at(), lsem.at())
+    ph, sh = [], []
+    for i in range(1, n):
+        peer = (me + i) % n
+        ph.append(shmem.putmem_nbi(o.at(me), x.at(), send.at(),
+                                   recv.at(), peer, _AXIS))
+        sh.append(shmem.putmem_nbi(so.at(me), sc.at(), s_send.at(),
+                                   s_recv.at(), peer, _AXIS))
+    lp.wait()
+    ls.wait()
+    for h in ph:
+        h.wait()            # payload: send + DELIVERY properly gated
+    for h in sh:
+        h.wait_send()       # scale row: delivery gate DROPPED
+    for j in range(n):
+        _v.read(o.at(j))
+        _v.read(so.at(j))   # dequant reads race in-flight scale writes
+
+
 @_v.mutant("rs_ring_no_credit", expect=_v.RACE,
            doc="RS ring with the credit flow control removed: symmetric "
                "acc-slot reuse without discharge — a fast upstream "

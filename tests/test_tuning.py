@@ -346,6 +346,32 @@ def test_bench_sp_prefill_arm_runs_end_to_end(bench_mod):
     assert bench_mod.check_result(dict(base, **out)) == []
 
 
+def test_bench_allreduce_wire_arm_runs_end_to_end(bench_mod):
+    """The quantized-wire AR bench arm (ISSUE 9) executes at a tiny
+    shape on the CPU interpreter — the world=1 forced-ring path
+    included (the n == 1 early returns are SKIPPED by force_kernel, so
+    a Mosaic-facing structural bug in that regime fails here, not in
+    the driver's artifact) — and emits the schema-clean travelling
+    key family."""
+    from triton_dist_tpu.runtime import make_mesh
+
+    mesh = make_mesh(mesh_shape=(1,), axis_names=("tp",))
+    for attempt in (0, 1):
+        try:
+            out = bench_mod.bench_allreduce_wire(
+                mesh, shape=(16, 128), ks=(1, 9, 17), k_hi=9, pairs=1)
+            break
+        except RuntimeError:
+            if attempt:
+                raise
+    assert bench_mod._AR_WIRE_KEYS <= set(out)
+    assert "diffs_ms" in out["allreduce_wire_raw"]
+    assert out["allreduce_wire_model_pick"] in ("native", "fp8", "int8")
+    base = {"metric": "m", "value": 1.0, "unit": "ms",
+            "vs_baseline": 1.0}
+    assert bench_mod.check_result(dict(base, **out)) == []
+
+
 def test_flash_prefill_perf_model():
     """The flash-vs-xla prefill pricing (ISSUE 7): the xla formulation
     carries the f32 logits-materialization traffic the kernel deletes,

@@ -1,0 +1,696 @@
+"""Quantized-wire codec plane tests (ISSUE 9, `triton_dist_tpu.wire`).
+
+Covers the four contracts the subsystem ships:
+
+  codec       one quantization definition (the fp8 path bitwise-pins
+              the legacy ep_a2a formula — the dedupe test), the int8
+              wire image layout, block-scale arithmetic and errors.
+  numerics    f32/native wire drift is 0 bitwise; drift is monotone in
+              scale-block size; every (collective, format) pair clears
+              the default error budget at n <= 8.
+  collectives wire_format= on AG (ring/full-mesh/LL), two-shot AR,
+              AG+GEMM and GEMM+RS over the 8-device mesh: the gather
+              family is BITWISE its in-jit pack/unpack roundtrip
+              (transport moves wire bytes, never changes them), the
+              reduction family pins its fold order against
+              wire.simulate_ring_rs (cosine drift ~0; exact bitwise is
+              not portable across compilation contexts — XLA may fuse
+              decode-mul-add into FMA differently) and its accuracy
+              against the native-wire result within the budget.
+  plumbing    choose_wire_format gating, prune_wire_formats, the trace
+              byte attribution, the bench schema family rules, and the
+              format-invariance theorem + wire mutant polarity.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu import wire
+
+N_DEV = 8
+
+
+def _legacy_quantize_fp8(x):
+    """The PINNED legacy ep_a2a formula (PR 2), spelled out so a codec
+    refactor that drifts from it fails here even if ep_a2a silently
+    follows the codec."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 448.0
+    s = jnp.maximum(s, 1e-12)
+    q = (x.astype(jnp.float32) / s[:, None]).astype(jnp.float8_e4m3fn)
+    return q, s
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def test_native_is_passthrough():
+    x = jnp.ones((4, 128), jnp.bfloat16)
+    assert wire.pack(x, None) is x
+    assert wire.unpack(x, (128,), "native", x.dtype) is x
+    assert wire.roundtrip(x, None) is x
+    assert wire.is_native(None) and wire.is_native("native")
+    assert not wire.is_native("fp8")
+
+
+@pytest.mark.parametrize("kind,tol", [("fp8", 0.10), ("int8", 0.02)])
+@pytest.mark.parametrize("block", [None, 128, 32])
+def test_roundtrip_within_format_tolerance(kind, tol, block):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 256)), jnp.bfloat16)
+    fmt = wire.WireFormat(kind, block)
+    r = wire.roundtrip(x, fmt)
+    assert r.shape == x.shape and r.dtype == x.dtype
+    err = np.abs(np.asarray(r, np.float32) - np.asarray(x, np.float32))
+    # per-row absmax scaling bounds the error by tol * the row's absmax
+    amax = np.abs(np.asarray(x, np.float32)).max(axis=-1, keepdims=True)
+    assert (err <= tol * amax + 1e-6).all()
+
+
+def test_fp8_matches_legacy_ep_formula_bitwise():
+    """THE dedupe pin: wire.quantize at per-row granularity is bitwise
+    the legacy ep_a2a._quantize_fp8 — payloads AND scales — and ep_a2a
+    itself now delegates to the codec, so the repo has exactly one
+    quantization definition."""
+    from triton_dist_tpu.kernels.ep_a2a import _quantize_fp8
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((32, 192)), jnp.bfloat16)
+    q_ref, s_ref = _legacy_quantize_fp8(x)
+    q_w, s_w = wire.quantize(x, "fp8")
+    np.testing.assert_array_equal(
+        np.asarray(q_ref).view(np.uint8), np.asarray(q_w).view(np.uint8))
+    np.testing.assert_array_equal(np.asarray(s_ref),
+                                  np.asarray(s_w[..., 0]))
+    q_ep, s_ep = _quantize_fp8(x)
+    np.testing.assert_array_equal(
+        np.asarray(q_ref).view(np.uint8), np.asarray(q_ep).view(np.uint8))
+    np.testing.assert_array_equal(np.asarray(s_ref), np.asarray(s_ep))
+
+
+def test_ep_pack_payload_bitwise_on_shared_codec():
+    """The EP dispatch's fp8 wire payload is byte-for-byte the pinned
+    legacy quantization of the routed tokens (the pack migration
+    changed zero wire bytes)."""
+    from triton_dist_tpu.kernels.ep_a2a import _pack_by_dest
+
+    rng = np.random.default_rng(2)
+    m, h, k, n_ranks, epr, cap = 16, 120, 2, 2, 2, 32
+    x = jnp.asarray(rng.standard_normal((m, h)) * 0.5, jnp.bfloat16)
+    ids = jnp.asarray(rng.integers(0, n_ranks * epr, (m, k)), jnp.int32)
+    w = jnp.asarray(rng.random((m, k)), jnp.float32)
+    pk = _pack_by_dest(x, ids, w, n_ranks, epr, cap,
+                       payload_dtype=jnp.float8_e4m3fn)
+    q_ref, s_ref = _legacy_quantize_fp8(x)
+    send = np.asarray(pk.send_x).view(np.uint8).reshape(n_ranks, cap, -1)
+    qb = np.asarray(q_ref).view(np.uint8)
+    sb = np.asarray(
+        jax.lax.bitcast_convert_type(s_ref, jnp.uint8))
+    rows = np.asarray(pk.src_rows)
+    valid = np.asarray(pk.valid)
+    for d in range(n_ranks):
+        for c in range(cap):
+            if not valid[d, c]:
+                continue
+            np.testing.assert_array_equal(send[d, c, :h], qb[rows[d, c]])
+            np.testing.assert_array_equal(send[d, c, h:h + 4],
+                                          sb[rows[d, c]])
+
+
+def test_wire_image_arithmetic_and_errors():
+    assert wire.wire_cols(128, "fp8") == 256  # 128 payload + 4 scale pad
+    assert wire.wire_cols(512, wire.WireFormat("int8", 128)) == 640
+    assert wire.wire_row_bytes(512, None, jnp.bfloat16) == 1024
+    assert wire.wire_row_bytes(512, "fp8", jnp.bfloat16) == \
+        wire.wire_cols(512, "fp8")
+    with pytest.raises(ValueError):
+        wire.WireFormat("fp4")
+    with pytest.raises(ValueError):
+        wire.n_blocks(100, wire.WireFormat("fp8", 32))  # 32 !| 100
+    with pytest.raises(ValueError):
+        wire.pack(jnp.ones((8,), jnp.float32), "fp8")  # 1-D
+    with pytest.raises(ValueError):
+        wire.wire_cols(128, "native")
+
+
+def test_encode_decode_rows_block_scaled():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((8, 256)), jnp.float32)
+    fmt = wire.WireFormat("int8", 64)
+    w = wire.encode_rows(x, fmt)
+    assert w.dtype == jnp.int8
+    assert w.shape == (8, wire.wire_cols(256, fmt))
+    back = wire.decode_rows(w, 256, fmt, jnp.float32)
+    q, s = wire.quantize(x, fmt)
+    np.testing.assert_array_equal(
+        np.asarray(back), np.asarray(wire.dequantize(q, s, fmt,
+                                                     jnp.float32)))
+
+
+# -- numerics harness ---------------------------------------------------------
+
+
+def test_native_wire_drift_is_zero_bitwise():
+    """f32/native wire drift == 0 BITWISE: codec roundtrip and every
+    collective simulation (ulp distance 0, not just allclose)."""
+    assert wire.codec_drift(None)["ulp"] == 0
+    for coll in wire.numerics.COLLECTIVES:
+        d = wire.collective_drift(coll, None, n=4, shape=(16, 128))
+        assert d["ulp"] == 0, (coll, d)
+
+
+@pytest.mark.parametrize("kind", ["fp8", "int8"])
+def test_drift_monotone_in_block_size(kind):
+    drifts = wire.drift_monotone_in_block(kind, h=512,
+                                          blocks=(32, 128, None))
+    assert drifts[0] <= drifts[1] <= drifts[2], drifts
+    assert drifts[2] > 0  # quantization is never free
+
+
+@pytest.mark.parametrize("kind", ["fp8", "int8"])
+def test_collective_drift_within_default_budget(kind):
+    """Every (collective, format) pair clears the default error budget
+    at n = 8 — the acceptance gate of the wire plane."""
+    for coll in wire.numerics.COLLECTIVES:
+        d = wire.collective_drift(coll, kind, n=8, shape=(16, 128))
+        assert 0 <= d["cos"] <= wire.DEFAULT_ERROR_BUDGET, (coll, kind, d)
+
+
+# -- collectives over the mesh ------------------------------------------------
+
+
+def test_ag_wire_bitwise_roundtrip(mesh8):
+    """Ring and full-mesh AG on a quantized wire are BITWISE the in-jit
+    pack/unpack roundtrip of the shards: the transport moves wire
+    bytes, never changes them. (One compiled program carries all
+    format x transport arms — interpret compile time dominates these
+    tests, so they share one jit.)"""
+    from triton_dist_tpu.kernels import (
+        full_mesh_all_gather,
+        ring_all_gather,
+    )
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((N_DEV * 8, 128)), jnp.bfloat16)
+
+    def fn(s):
+        return (ring_all_gather(s, "tp", wire_format="fp8"),
+                ring_all_gather(s, "tp", wire_format="int8"),
+                full_mesh_all_gather(s, "tp", wire_format="fp8"),
+                wire.roundtrip(s, "fp8"), wire.roundtrip(s, "int8"))
+
+    r8, ri, f8, rt8, rti = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=P("tp"),
+        out_specs=(P(), P(), P(), P("tp"), P("tp")),
+        check_vma=False))(x)
+    for got, rt, name in ((r8, rt8, "ring fp8"), (ri, rti, "ring int8"),
+                          (f8, rt8, "full_mesh fp8")):
+        np.testing.assert_array_equal(
+            np.asarray(got.astype(jnp.float32)),
+            np.asarray(rt.astype(jnp.float32)), err_msg=name)
+
+
+def test_ll_ag_wire_parity_reuse(mesh8):
+    """LL AG on the fp8 wire: back-to-back calls (parity slot reuse)
+    each gather the bitwise roundtrip of every shard."""
+    from triton_dist_tpu.kernels import create_ll_ag_buffer, ll_all_gather
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((N_DEV * 4, 128)), jnp.bfloat16)
+
+    def fn(s):
+        buf = create_ll_ag_buffer(s.shape, s.dtype, N_DEV,
+                                  wire_format="fp8")
+        o0, buf = ll_all_gather(s, buf, 0, "tp", wire_format="fp8")
+        o1, buf = ll_all_gather(s, buf, 1, "tp", wire_format="fp8")
+        o2, buf = ll_all_gather(s, buf, 2, "tp", wire_format="fp8")
+        return o0, o2, wire.roundtrip(s, "fp8")
+
+    o0, o2, rt = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=P("tp"),
+        out_specs=(P(None, "tp"), P(None, "tp"), P("tp")),
+        check_vma=False))(x)
+    exp = np.asarray(rt.astype(jnp.float32)).reshape(N_DEV, 4, 128)
+    for o in (o0, o2):
+        # got[j, r] = rank r's gathered slot j = roundtrip of shard j
+        got = np.asarray(o.astype(jnp.float32)).reshape(
+            N_DEV, N_DEV, 4, 128)
+        for j in range(N_DEV):
+            np.testing.assert_array_equal(
+                got[j], np.broadcast_to(exp[j], (N_DEV, 4, 128)))
+
+
+def test_rs_wire_fold_order_and_accuracy(mesh8):
+    """Quantized ring RS (fp8 AND int8, one compiled program): (a) fold
+    order pinned against the mesh-free simulation (cosine drift ~0 —
+    bitwise is not portable across compilation contexts, see module
+    doc), (b) result within the default budget of the native fold."""
+    from triton_dist_tpu.kernels.reduce_scatter import ring_reduce_scatter
+
+    rng = np.random.default_rng(6)
+    data = rng.standard_normal((N_DEV, N_DEV * 8, 128)).astype(np.float32)
+    stacked = jnp.asarray(data, jnp.bfloat16)
+
+    def fn(xs):
+        s = xs[0].astype(jnp.bfloat16)
+        return tuple(ring_reduce_scatter(s, "tp", wire_format=f)
+                     for f in ("fp8", "int8", None))
+
+    outs = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=P("tp"),
+        out_specs=(P("tp"),) * 3, check_vma=False))(jnp.asarray(data))
+    got = {f: np.asarray(o, np.float32)
+           for f, o in zip(("fp8", "int8", None), outs)}
+    for kind in ("fp8", "int8"):
+        sim = np.asarray(
+            wire.simulate_ring_rs(stacked, kind, N_DEV).astype(
+                jnp.bfloat16).astype(jnp.float32)).reshape(N_DEV * 8, 128)
+        assert wire.cosine_drift(got[kind], sim) <= 1e-6, kind
+        assert wire.cosine_drift(got[kind], got[None]) \
+            <= wire.DEFAULT_ERROR_BUDGET, kind
+
+
+def test_rs_wire_rejects_conflicting_accum_dtype(mesh8):
+    from triton_dist_tpu.kernels.reduce_scatter import ring_reduce_scatter
+
+    x = jnp.ones((N_DEV * 8, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="accumulates in f32"):
+        jax.jit(jax.shard_map(
+            lambda s: ring_reduce_scatter(s, "tp",
+                                          accum_dtype=jnp.bfloat16,
+                                          wire_format="fp8"),
+            mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+            check_vma=False))(x)
+
+
+@pytest.mark.slow  # RS leg (rs_fold), AG leg (ag_bitwise) and the composed AR drift
+# (dryrun wire plane, n=4) are all tier-1-covered; the n=8 mesh
+# composition rides deep runs only
+def test_two_shot_ar_wire_within_budget(mesh8):
+    """fp8/int8 two-shot AR vs the native-wire AR (one compiled
+    program), plus the fp8 fold pinned against the mesh-free
+    simulation."""
+    from triton_dist_tpu.kernels import two_shot_all_reduce
+
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((N_DEV, N_DEV * 4, 128)).astype(np.float32)
+
+    def fn(xs):
+        s = xs[0].astype(jnp.bfloat16)
+        return tuple(two_shot_all_reduce(s, "tp", wire_format=f)
+                     for f in (None, "fp8", "int8"))
+
+    outs = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=P("tp"),
+        out_specs=(P("tp"),) * 3, check_vma=False))(jnp.asarray(data))
+    native, fp8, int8 = (np.asarray(o, np.float32) for o in outs)
+    for kind, got in (("fp8", fp8), ("int8", int8)):
+        drift = wire.cosine_drift(got, native)
+        assert drift <= wire.DEFAULT_ERROR_BUDGET, (kind, drift)
+    # AR fold pinned against the mesh-free simulation too (the gathered
+    # output replicates the reduced tensor once per rank)
+    sim = np.asarray(wire.simulate_allreduce(
+        jnp.asarray(data, jnp.bfloat16), "fp8", N_DEV).astype(
+            jnp.bfloat16).astype(jnp.float32))
+    got0 = fp8.reshape(N_DEV, N_DEV * 4, 128)[0]
+    assert wire.cosine_drift(got0, sim) <= 1e-6
+
+
+@pytest.mark.slow  # auto gating is tier-1-covered mesh-free (chooser tests) plus the
+# non-divisible regression; deep-run only
+def test_all_reduce_wire_entry(mesh8):
+    """all_reduce(wire_format=...) forces the two-shot wire path;
+    "auto" with budget 0.0 degrades to the native method chain (one
+    compiled program for both arms)."""
+    from triton_dist_tpu.kernels import all_reduce
+
+    rng = np.random.default_rng(8)
+    data = rng.standard_normal((N_DEV, N_DEV * 2, 128)).astype(np.float32)
+
+    def fn(xs):
+        return (all_reduce(xs[0], "tp", wire_format="auto",
+                           error_budget=0.0),
+                all_reduce(xs[0], "tp", wire_format="int8"))
+
+    auto0, int8 = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=P("tp"), out_specs=(P("tp"), P("tp")),
+        check_vma=False))(jnp.asarray(data))
+    ref = data.sum(0)
+    rep = np.broadcast_to(ref, (N_DEV,) + ref.shape).reshape(
+        N_DEV * N_DEV * 2, 128)
+    np.testing.assert_allclose(np.asarray(auto0, np.float32), rep,
+                               rtol=1e-5, atol=1e-5)
+    assert wire.cosine_drift(np.asarray(int8, np.float32), rep) \
+        <= wire.DEFAULT_ERROR_BUDGET
+
+
+def test_all_reduce_auto_wire_non_divisible(mesh8):
+    """"auto" on a shape the two-shot construct cannot express (leading
+    dim not divisible by n) degrades to the native method chain — the
+    admissible format set is {native} there — while an EXPLICITLY
+    requested quantized wire stays a loud error."""
+    from triton_dist_tpu.kernels import all_reduce
+
+    rng = np.random.default_rng(14)
+    data = rng.standard_normal((N_DEV, 10, 128)).astype(np.float32)
+
+    def run(**kw):
+        return jax.jit(jax.shard_map(
+            lambda xs: all_reduce(xs[0], "tp", **kw),
+            mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"),
+            check_vma=False))(jnp.asarray(data))
+
+    out = np.asarray(run(wire_format="auto"), np.float32)
+    ref = data.sum(0)
+    np.testing.assert_allclose(
+        out, np.broadcast_to(ref, (N_DEV,) + ref.shape).reshape(
+            N_DEV * 10, 128), rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        run(wire_format="fp8")
+
+
+def test_ag_gemm_wire_matches_wire_reference(mesh8):
+    """The fused AG+GEMM wire leg (in-kernel consume-edge dequant)
+    computes the roundtrip-composed product: cosine drift vs the
+    explicit gather-decode-dot reference is reassociation-level (~1e-9),
+    in both output orders."""
+    from triton_dist_tpu.kernels import AgGemmConfig, ag_gemm
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        arrival_to_rank_order,
+    )
+
+    rng = np.random.default_rng(9)
+    m_loc, k, n_loc = 16, 256, 128
+    a = jnp.asarray(rng.standard_normal((N_DEV * m_loc, k)) * 0.1,
+                    jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((k, n_loc)) * 0.1, jnp.bfloat16)
+    cfg = AgGemmConfig(tile_m=8, tile_n=128, tile_k=128,
+                       vmem_budget=64 << 20)
+
+    def fn(aa, bb):
+        af = jax.lax.all_gather(wire.pack(aa, "fp8"), "tp", tiled=True)
+        af = wire.unpack(af, (k,), "fp8", aa.dtype)
+        ref = jnp.dot(af, bb,
+                      preferred_element_type=jnp.float32).astype(
+                          aa.dtype)
+        return (
+            ag_gemm(aa, bb, "tp", config=cfg, force_kernel=True,
+                    c_order="rank", wire_format="fp8"),
+            ag_gemm(aa, bb, "tp", config=cfg, force_kernel=True,
+                    c_order="arrival", wire_format="fp8"),
+            ref, arrival_to_rank_order(ref, "tp"),
+        )
+
+    g_rank, g_arr, ref_rank, ref_arr = jax.jit(jax.shard_map(
+        fn, mesh=mesh8, in_specs=(P("tp"), P(None)),
+        out_specs=(P(None, "tp"),) * 4, check_vma=False))(a, b)
+    for order, got, ref in (("rank", g_rank, ref_rank),
+                            ("arrival", g_arr, ref_arr)):
+        drift = wire.cosine_drift(np.asarray(got.astype(jnp.float32)),
+                                  np.asarray(ref.astype(jnp.float32)))
+        assert drift <= 1e-8, (order, drift)
+
+
+def test_ag_gemm_wire_rejects_unsupported_forms(mesh8):
+    from triton_dist_tpu.kernels import ag_gemm
+
+    a = jnp.ones((N_DEV * 8, 128), jnp.bfloat16)
+    bg = jnp.ones((128, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="dense ag_gemm form"):
+        jax.jit(jax.shard_map(
+            lambda aa, g, u: ag_gemm(aa, (g, u), "tp",
+                                     epilogue="silu_pair",
+                                     wire_format="fp8"),
+            mesh=mesh8, in_specs=(P("tp"), P(None), P(None)),
+            out_specs=P(None, "tp"), check_vma=False))(a, bg, bg)
+
+
+@pytest.mark.parametrize("budget,want", [(32 << 20, "resident"),
+                                         (16 << 10, "streamed")])
+def test_gemm_rs_wire_regimes(mesh8, budget, want):
+    """Both ring regimes of gemm_rs ride the wire: the dispatched
+    regime is asserted (the round-5 lesson — a regime-targeted test
+    must prove it exercised what it claims) and the result stays
+    within the budget of the unfused native reference."""
+    from triton_dist_tpu.kernels import GemmRsConfig, gemm_rs, gemm_rs_ref
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import last_regime
+
+    rng = np.random.default_rng(10)
+    m, k_loc, n_full = 32, 128, 128
+    a = jnp.asarray(rng.standard_normal((m, k_loc)) * 0.1, jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((k_loc, n_full)) * 0.1,
+                    jnp.bfloat16)
+    cfg = GemmRsConfig(tile_m=8, tile_n=128, vmem_budget=budget)
+    got = jax.jit(jax.shard_map(
+        lambda aa, bb: gemm_rs(aa, bb, "tp", config=cfg,
+                               force_kernel=True, wire_format="fp8"),
+        mesh=mesh8, in_specs=(P(None), P(None)), out_specs=P("tp"),
+        check_vma=False))(a, b)
+    assert last_regime() == want
+    ref = jax.jit(jax.shard_map(
+        lambda aa, bb: gemm_rs_ref(aa, bb, "tp"),
+        mesh=mesh8, in_specs=(P(None), P(None)), out_specs=P("tp"),
+        check_vma=False))(a, b)
+    drift = wire.cosine_drift(np.asarray(got.astype(jnp.float32)),
+                              np.asarray(ref.astype(jnp.float32)))
+    assert drift <= wire.DEFAULT_ERROR_BUDGET
+
+
+@pytest.mark.slow  # the kernel-count invariant also holds the dryrun's pallas_kernels
+# tally stable; deep-run only
+def test_wire_adds_no_pallas_calls(mesh8):
+    """The wire plane is codec + the SAME transport kernels: a
+    quantized AG traces exactly as many pallas_calls as the native one
+    (pack/unpack are jnp), and the native path is bit-identical to the
+    pre-wire call signature (wire_format=None is the default)."""
+    from triton_dist_tpu.kernels import ring_all_gather
+    from triton_dist_tpu.lang.core import pallas_call_count
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((N_DEV * 4, 128)), jnp.float32)
+
+    def run(fmt):
+        before = pallas_call_count()
+        out = jax.jit(jax.shard_map(
+            functools.partial(ring_all_gather, axis="tp",
+                              wire_format=fmt),
+            mesh=mesh8, in_specs=P("tp"), out_specs=P(),
+            check_vma=False))(x)
+        return np.asarray(out), pallas_call_count() - before
+
+    nat, nat_calls = run(None)
+    q, q_calls = run("fp8")
+    np.testing.assert_array_equal(nat, np.asarray(x))
+    assert nat_calls == q_calls == 1
+
+
+# -- model / autotuner gating -------------------------------------------------
+
+
+def test_choose_wire_format_gating():
+    from triton_dist_tpu.perf_model import CHIPS, choose_wire_format
+
+    chip = CHIPS["TPU v5 lite"]
+    mb16 = 16 << 20
+    # no ICI to save: native
+    assert wire.is_native(choose_wire_format(mb16, 1, chip=chip))
+    # budget 0 forces native at any world
+    assert wire.is_native(choose_wire_format(mb16, 8, error_budget=0.0,
+                                             chip=chip))
+    # ICI-bound: a quantized format wins under the default budget
+    pick = choose_wire_format(mb16, 8, chip=chip, row_width=5120)
+    assert pick.kind in ("fp8", "int8")
+    # a budget between int8's and fp8's modeled AR drift admits int8 only
+    from triton_dist_tpu.perf_model import estimate_wire_drift
+
+    mid = (estimate_wire_drift("int8", 8, "allreduce")
+           + estimate_wire_drift("fp8", 8, "allreduce")) / 2
+    assert choose_wire_format(mb16, 8, error_budget=mid, chip=chip,
+                              row_width=5120).kind == "int8"
+
+
+def test_prune_wire_formats_discipline():
+    from triton_dist_tpu.autotuner import prune_wire_formats
+
+    live = prune_wire_formats(16 << 20, 8, row_width=5120)
+    assert any(wire.is_native(f) for f in live)  # native always survives
+    kinds = {f.kind for f in live}
+    assert "fp8" in kinds and "int8" in kinds
+    # budget 0: only native survives
+    only = prune_wire_formats(16 << 20, 8, error_budget=0.0)
+    assert all(wire.is_native(f) for f in only) and only
+    capped = prune_wire_formats(16 << 20, 8, row_width=5120, top_n=2)
+    assert len(capped) == 2 and any(wire.is_native(f) for f in capped)
+
+
+def test_wire_shrink_and_roofline():
+    from triton_dist_tpu.perf_model import (
+        CHIPS,
+        estimate_collective_wire_ms,
+        wire_shrink,
+    )
+
+    assert wire_shrink(jnp.bfloat16, None) == 1.0
+    s8 = wire_shrink(jnp.bfloat16, "fp8", 5120)
+    assert 0.5 < s8 < 0.55  # 1 byte payload + scales/padding vs 2
+    assert wire_shrink(jnp.float32, "fp8", 5120) < s8
+    chip = CHIPS["TPU v5 lite"]
+    nat = estimate_collective_wire_ms("allreduce", 16 << 20, 8,
+                                      jnp.bfloat16, None, chip)
+    q = estimate_collective_wire_ms("allreduce", 16 << 20, 8,
+                                    jnp.bfloat16, "fp8", chip,
+                                    row_width=5120)
+    assert q < nat  # ICI-bound: halved wire beats the codec tax
+    n1 = estimate_collective_wire_ms("allreduce", 16 << 20, 1,
+                                     jnp.bfloat16, "fp8", chip)
+    assert n1 > 0  # pure codec tax at world=1
+
+
+# -- trace byte attribution ---------------------------------------------------
+
+
+def test_wire_send_bytes_attribution(mesh8):
+    """Per-format byte attribution on the AG+GEMM ring's delivery
+    spans: the traced event count is format-invariant, so the same
+    traced run prices bytes in exactly the packed ratio."""
+    from triton_dist_tpu import trace
+    from triton_dist_tpu.kernels import AgGemmConfig, ag_gemm
+
+    rng = np.random.default_rng(12)
+    m_loc, k, n_loc = 8, 128, 128
+    a = jnp.asarray(rng.standard_normal((N_DEV * m_loc, k)) * 0.1,
+                    jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((k, n_loc)) * 0.1, jnp.bfloat16)
+    cfg = AgGemmConfig(tile_m=8, tile_n=128, tile_k=128,
+                       vmem_budget=64 << 20)
+
+    def traced(fmt):
+        with trace.building(cap=256):
+            _c, tbuf = jax.jit(jax.shard_map(
+                lambda aa, bb: ag_gemm(aa, bb, "tp", config=cfg,
+                                       force_kernel=True,
+                                       c_order="arrival",
+                                       wire_format=fmt),
+                mesh=mesh8, in_specs=(P("tp"), P(None)),
+                out_specs=(P(None, "tp"), P("tp")),
+                check_vma=False))(a, b)
+        return trace.assemble({"ag": np.asarray(tbuf).reshape(
+            N_DEV, -1, trace.RECORD_WORDS)})
+
+    rows = m_loc
+    per_fmt = {}
+    for fmt in (None, "fp8"):
+        tl = traced(fmt)
+        row_bytes = wire.wire_row_bytes(k, fmt, jnp.bfloat16)
+        per_fmt[fmt] = trace.wire_send_bytes(
+            tl, "ag", "ag.ring_wait", rows * row_bytes)
+    for rank in range(N_DEV):
+        # (n-1) delivery waits per rank, each pricing one forwarded chunk
+        assert per_fmt[None][rank] == \
+            (N_DEV - 1) * rows * k * 2
+        assert per_fmt["fp8"][rank] == \
+            (N_DEV - 1) * rows * wire.wire_cols(k, "fp8")
+    total_nat = sum(per_fmt[None].values())
+    total_fp8 = sum(per_fmt["fp8"].values())
+    assert total_fp8 / total_nat == pytest.approx(
+        wire.wire_cols(k, "fp8") / (k * 2))
+
+
+# -- verify: format invariance + mutant polarity ------------------------------
+
+
+def test_format_invariance_theorem():
+    from triton_dist_tpu.verify import registry
+
+    fmtd = registry.format_parameterized()
+    assert set(fmtd) >= {"allgather", "reduce_scatter", "allreduce",
+                         "low_latency_allgather", "allgather_gemm",
+                         "gemm_reduce_scatter"}
+    assert registry.check_format_invariance() == []
+
+
+def test_format_invariance_catches_divergence():
+    """A wire variant that grows its own semaphore op must trip the
+    invariance check (the theorem is falsifiable)."""
+    from triton_dist_tpu import verify as v
+    from triton_dist_tpu.lang import shmem
+    from triton_dist_tpu.verify import engine
+
+    def proto(n, fmt="native"):
+        me = shmem.my_pe("tp")
+        x, o = v.ref("x"), v.ref("o")
+        send, recv = v.sem("send"), v.sem("recv")
+        h = shmem.putmem_nbi(o.at(me), x.at(), send.at(), recv.at(),
+                             (me + 1) % n, "tp")
+        h.wait()
+        if fmt != "native":
+            # an extra scale-plane signal: protocol-visible divergence
+            extra = v.sem("scale_flag")
+            shmem.signal(extra.at(), 1, shmem.SIGNAL_ADD, (me + 1) % n,
+                         "tp")
+        for j in range(n):
+            v.read(o.at(j))
+
+    s_nat = engine.protocol_skeleton(proto, 4)
+    s_fp8 = engine.protocol_skeleton(proto, 4, fmt="fp8")
+    assert s_nat != s_fp8
+    # and the matching formats compare equal (determinism)
+    assert s_nat == engine.protocol_skeleton(proto, 4)
+
+
+def test_wire_mutant_polarity():
+    """The scale-row-without-delivery-gate mutant is flagged in its
+    registered race class."""
+    import _mutants  # noqa: F401  (registers the corpus)
+    from triton_dist_tpu import verify as v
+    from triton_dist_tpu.verify import registry
+
+    muts = registry.mutants()
+    assert "wire_scale_no_gate" in muts
+    spec = muts["wire_scale_no_gate"]
+    assert spec.expect == v.RACE
+    classes = {f.klass for f in registry.verify_spec(spec)}
+    assert v.RACE in classes
+
+
+# -- bench schema -------------------------------------------------------------
+
+
+def test_bench_wire_keys_travel_together():
+    import bench
+
+    base = {"metric": "m", "value": 1.0, "unit": "ms",
+            "vs_baseline": 1.0}
+    raw = {"diffs_ms": [1.0], "p25_ms": 1.0, "min_ms": 1.0}
+    full = dict(base, allreduce_wire_native_us=10.0,
+                allreduce_wire_fp8_us=12.0,
+                allreduce_wire_int8_us=12.5,
+                allreduce_wire_fp8_vs_native=1.2,
+                allreduce_wire_int8_vs_native=1.25,
+                allreduce_wire_raw=raw,
+                allreduce_wire_model_pick="fp8")
+    assert bench.check_result(full) == []
+    # a ratio without its arms is unfalsifiable
+    partial = dict(base, allreduce_wire_fp8_vs_native=1.2)
+    probs = bench.check_result(partial)
+    assert any("travel together" in p for p in probs)
+    # tail stats are mandatory on the wire chain dict
+    no_raw = dict(full)
+    del no_raw["allreduce_wire_raw"]
+    assert any("allreduce_wire_raw" in p
+               for p in bench.check_result(no_raw))
+    # the model pick is part of the artifact
+    no_pick = dict(full)
+    del no_pick["allreduce_wire_model_pick"]
+    assert any("model_pick" in p for p in bench.check_result(no_pick))
+    # the AG+GEMM wire pair travels together too
+    ag_partial = dict(base, ag_gemm_wire_fp8_ms=1.0)
+    assert any("travel together" in p
+               for p in bench.check_result(ag_partial))
+    ag_full = dict(base, ag_gemm_wire_fp8_ms=1.0,
+                   ag_gemm_wire_fp8_vs_native=1.1)
+    assert bench.check_result(ag_full) == []

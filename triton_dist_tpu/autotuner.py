@@ -446,6 +446,65 @@ def prune_flash_prefill_configs(s_q, t, hq, hkv, d, configs=None,
     return uniq
 
 
+def wire_format_space():
+    """Candidate wire formats for a measured quantized-collective sweep
+    (bench.py's allreduce-wire arm, a caller's autotune over the
+    wire_format= knob): the pass-through native wire plus the shipped
+    quantized codecs at per-row and 128-element scale blocks."""
+    from triton_dist_tpu.wire import codec
+
+    return [
+        codec.NATIVE,
+        codec.FP8,
+        codec.INT8,
+        codec.WireFormat("fp8", 128),
+        codec.WireFormat("int8", 128),
+    ]
+
+
+def prune_wire_formats(nbytes, n, dtype=None, collective="allreduce",
+                       error_budget=None, configs=None, chip=None,
+                       row_width=512, top_n=None):
+    """Model-pruned wire-format candidates: drop the formats whose
+    modeled drift (perf_model.estimate_wire_drift) exceeds the error
+    budget — a QUALITY gate the time model must not fold away, exactly
+    like prune_ep_moe_configs keeps capacity-factor levels apart — then
+    rank survivors by the bytes-by-precision roofline
+    (perf_model.estimate_collective_wire_ms) and optionally cap at
+    top_n. Native always survives (the fallback a tuned pick degrades
+    to), so the result is never empty."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.perf_model import (
+        estimate_collective_wire_ms,
+        estimate_wire_drift,
+    )
+    from triton_dist_tpu.wire import codec
+    from triton_dist_tpu.wire.numerics import DEFAULT_ERROR_BUDGET
+
+    dtype = dtype or jnp.bfloat16
+    budget = DEFAULT_ERROR_BUDGET if error_budget is None else error_budget
+    configs = list(configs) if configs is not None else wire_format_space()
+    live = [codec.resolve(f) for f in configs]
+    live = [f for f in live
+            if codec.is_native(f)
+            or estimate_wire_drift(f, n, collective) <= budget]
+    if not any(codec.is_native(f) for f in live):
+        live.insert(0, codec.NATIVE)
+
+    def model_ms(f):
+        return estimate_collective_wire_ms(
+            collective, nbytes, n, dtype, f, chip, row_width)
+
+    live = sorted(live, key=model_ms)
+    if top_n is not None and len(live) > top_n:
+        keep = live[:top_n]
+        if not any(codec.is_native(f) for f in keep):
+            keep[-1] = codec.NATIVE
+        live = keep
+    return live
+
+
 def ep_moe_config_space():
     """Candidate EpMoeConfig grid for the chunk-pipelined EP MoE
     (kernels/ep_a2a.ep_moe_pipeline): chunk counts spanning no-pipelining

@@ -39,6 +39,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.wire import codec as wcodec
 
 
 class AllGatherMethod(enum.Enum):
@@ -137,9 +138,47 @@ def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
     )(x)
 
 
-def ring_all_gather(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
-    """Ring AG of per-device shard `x` -> (n*m, ...). Call inside shard_map."""
-    if jax.lax.axis_size(axis) == 1:
+def _wire_ag(x: jax.Array, axis: str, fmt, transport,
+             force_kernel: bool) -> jax.Array:
+    """Quantized-wire gather: encode ONCE at the send edge (the wire
+    image is what every transport below moves — ring forwards re-send
+    received bytes unchanged, so there is no per-hop requantization on
+    the gather family), decode every slot at the consume edge. The
+    transport kernel is the UNCHANGED native kernel running on the int8
+    wire image — the semaphore protocol is format-invariant by
+    construction (and by verifier proof). Every slot — including the
+    rank's own — passes the codec, so the gathered tensor has uniform
+    wire fidelity (kernel output is BITWISE the pack/unpack roundtrip
+    composition, which the tests pin)."""
+    n = jax.lax.axis_size(axis)
+    w = wcodec.pack(x, fmt)
+    if n == 1 and not force_kernel:
+        gathered = w
+    elif interpret_no_headroom():
+        gathered = jax.lax.all_gather(w, axis, tiled=True)
+    else:
+        gathered = transport(w)
+    return wcodec.unpack(gathered, x.shape[1:], fmt, x.dtype)
+
+
+def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
+                    force_kernel: bool = False) -> jax.Array:
+    """Ring AG of per-device shard `x` -> (n*m, ...). Call inside shard_map.
+
+    wire_format: payload encoding on the wire (wire.WireFormat; None =
+    native). Quantized formats move the block-scaled int8 wire image
+    through the SAME ring kernel — encoded once at the send edge,
+    decoded at the consume edge (see _wire_ag). force_kernel skips the
+    n == 1 early return (bench.py wire arms measure the world=1 edge
+    cost)."""
+    fmt = wcodec.resolve(wire_format)
+    if not wcodec.is_native(fmt):
+        return _wire_ag(
+            x, axis, fmt,
+            lambda w: _pallas_ag(w, axis, _ring_ag_kernel,
+                                 f"ring_ag_{axis}", per_step_recv=True),
+            force_kernel)
+    if jax.lax.axis_size(axis) == 1 and not force_kernel:
         return x
     if interpret_no_headroom():
         return jax.lax.all_gather(x, axis, tiled=True)
@@ -147,10 +186,19 @@ def ring_all_gather(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
                       per_step_recv=True)
 
 
-def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS) -> jax.Array:
+def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS,
+                         wire_format=None) -> jax.Array:
     """Full-mesh push AG (latency-optimal for small messages). All incoming
     puts target distinct slots and are only consumed after the full wait, so
-    a single shared recv semaphore is exact here."""
+    a single shared recv semaphore is exact here. wire_format as in
+    ring_all_gather (the push moves the wire image)."""
+    fmt = wcodec.resolve(wire_format)
+    if not wcodec.is_native(fmt):
+        return _wire_ag(
+            x, axis, fmt,
+            lambda w: _pallas_ag(w, axis, _full_mesh_ag_kernel,
+                                 f"fm_ag_{axis}", per_step_recv=False),
+            force_kernel=False)
     if jax.lax.axis_size(axis) == 1:
         return x
     if interpret_no_headroom():
@@ -163,13 +211,16 @@ def all_gather(
     x: jax.Array,
     axis: Union[str, Sequence[str]] = TP_AXIS,
     method: AllGatherMethod = AllGatherMethod.Auto,
+    wire_format=None,
 ) -> jax.Array:
     """Gather shards along mesh axis/axes; per-device function.
 
     Axis tuples run stage-wise (innermost first) — the 2-D analog of the
     reference's NUMA-aware 2-D ring (ref: allgather.py:196-261): gather over
     the fast axis, then the slow axis, each stage moving already-gathered
-    super-chunks.
+    super-chunks. wire_format applies PER STAGE (each stage re-encodes
+    its already-gathered super-chunks — wire fidelity compounds once per
+    axis; see docs/performance.md "Quantized wire").
     """
     if not isinstance(axis, str):
         stage_method = (
@@ -179,7 +230,8 @@ def all_gather(
         )
         out = x
         for ax in reversed(tuple(axis)):
-            out = all_gather(out, ax, method=stage_method)
+            out = all_gather(out, ax, method=stage_method,
+                             wire_format=wire_format)
         return out
 
     if method == AllGatherMethod.Ring2D:
@@ -191,18 +243,25 @@ def all_gather(
         nbytes = x.size * x.dtype.itemsize
         method = choose_allgather_method(nbytes)
     if method == AllGatherMethod.XLA:
+        if not wcodec.is_native(wire_format):
+            # wire fidelity is a property of the bytes moved, not of the
+            # transport: the XLA arm gathers the same wire image
+            return wcodec.unpack(
+                jax.lax.all_gather(wcodec.pack(x, wire_format), axis,
+                                   tiled=True),
+                x.shape[1:], wire_format, x.dtype)
         return jax.lax.all_gather(x, axis, tiled=True)
     if method == AllGatherMethod.Ring1D:
-        return ring_all_gather(x, axis)
+        return ring_all_gather(x, axis, wire_format=wire_format)
     if method == AllGatherMethod.FullMesh:
-        return full_mesh_all_gather(x, axis)
+        return full_mesh_all_gather(x, axis, wire_format=wire_format)
     raise ValueError(f"unknown method {method}")
 
 
 @functools.lru_cache(maxsize=None)
-def _ag_op_jit(mesh, axis: str, method: AllGatherMethod):
+def _ag_op_jit(mesh, axis: str, method: AllGatherMethod, fmt):
     def fn(xs):
-        return all_gather(xs, axis, method=method)
+        return all_gather(xs, axis, method=method, wire_format=fmt)
 
     return jax.jit(
         jax.shard_map(
@@ -216,10 +275,13 @@ def all_gather_op(
     mesh,
     axis: str = TP_AXIS,
     method: AllGatherMethod = AllGatherMethod.Auto,
+    wire_format=None,
 ) -> jax.Array:
     """Host-level AG on a global array sharded along its leading dim
-    (ref host entry: allgather.py:263-338 dispatch wrappers)."""
-    return _ag_op_jit(mesh, axis, method)(arr)
+    (ref host entry: allgather.py:263-338 dispatch wrappers).
+    wire_format as in all_gather."""
+    return _ag_op_jit(mesh, axis, method,
+                      wcodec.resolve(wire_format))(arr)
 
 
 # -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
@@ -228,10 +290,14 @@ from triton_dist_tpu import verify as _v  # noqa: E402
 
 
 @_v.protocol("allgather",
-             grid=({"method": "ring"}, {"method": "full_mesh"}),
+             grid=({"method": "ring"}, {"method": "full_mesh"},
+                   {"method": "ring", "fmt": "fp8"},
+                   {"method": "full_mesh", "fmt": "fp8"},
+                   {"method": "ring", "fmt": "int8"}),
              doc="ring AG (_ring_ag_kernel) / full-mesh push "
-                 "(fcollect)")
-def _ag_protocol(n, method="ring", prefix=""):
+                 "(fcollect); fmt != native models the same transport "
+                 "over the packed wire image (_wire_ag)")
+def _ag_protocol(n, method="ring", prefix="", fmt="native"):
     """Ring: step s forwards chunk (me-s) to the right neighbor on the
     per-step recv semaphore (a shared one would let step s's wait be
     satisfied by a step s+k arrival — the race the per-step slots
@@ -239,12 +305,25 @@ def _ag_protocol(n, method="ring", prefix=""):
     the fcollect primitive, shared recv semaphore made exact by the
     full wait before any slot is consumed.
 
+    `fmt` mirrors the wire_format knob: the gather family encodes ONCE
+    at the send edge (a pack of the local shard before the transport)
+    and decodes every slot at the consume edge — the transport moves
+    wire bytes on the IDENTICAL semaphore protocol (the kernel is
+    literally the same function running on the int8 image), which
+    `registry.check_format_invariance` proves from the captured
+    skeletons.
+
     `prefix` namespaces buffers/semaphores when this skeleton is
     embedded in a larger protocol (two-shot allreduce)."""
+    wire = fmt != "native"
     me = shmem.my_pe(TP_AXIS)
     x, o = _v.ref(prefix + "x"), _v.ref(prefix + "out")
     lsem = _v.sem(prefix + "local_sem")
     send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sem")
+    if wire:
+        # send edge: pack x into the wire image the transport moves
+        _v.read(x.at())
+        _v.write(x.at())
     if method == "full_mesh":
         shmem.barrier_all(TP_AXIS)
         shmem.fcollect(o, x, lsem.at(), send.at(), recv.at(), TP_AXIS, n)
@@ -262,4 +341,4 @@ def _ag_protocol(n, method="ring", prefix=""):
         # send source; program order is the dependency chain
         h.wait()
     for j in range(n):
-        _v.read(o.at(j))
+        _v.read(o.at(j))  # consume edge (wire: the per-slot decode)

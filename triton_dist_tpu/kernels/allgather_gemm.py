@@ -71,6 +71,7 @@ from triton_dist_tpu.lang.core import (
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.trace import events as trace_ev
+from triton_dist_tpu.wire import codec as wcodec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,7 +112,13 @@ def _silu_mul_f32(g, u):
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
                     need_ws: bool, cache_a: bool, silu_pair: bool,
-                    arrival: bool, grouped: bool, build, *refs):
+                    arrival: bool, grouped: bool, wire, build, *refs):
+    # `wire`: None for the native payload, else (fmt, k) — the A shard /
+    # ring workspace hold the block-scaled int8 wire image (payload
+    # columns [0, k), per-row f32 scales bitcast at [k, k+4)); the ring
+    # forward moves wire bytes on the IDENTICAL protocol, and the
+    # consumer dequantizes each A tile at the consume edge, right
+    # before the dot (see ag_gemm's wire_format doc).
     refs = list(refs)
     a_ref, b_ref = refs[:2]
     del refs[:2]
@@ -120,13 +127,21 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     del refs[:2]
     tbuf = refs.pop(0) if build is not None else None
     a_buf = refs.pop(0)
+    scale_buf = refs.pop(0) if wire is not None else None
     # nk==1 (full-K tiles) stores the dot straight to the output block:
     # no accumulator scratch is allocated (see the consumer below)
     acc = refs.pop(0) if nk > 1 else None
     acc2 = refs.pop(0) if (silu_pair and nk > 1) else None
     stage = None if arrival else refs.pop(0)
     tcur = refs.pop() if build is not None else None
-    if arrival:
+    sc_sem = None
+    if wire is not None:
+        if arrival:
+            ld_sems, sc_sem, cp_sem, send_sem, recv_sems = refs
+            st_sem = None
+        else:
+            ld_sems, sc_sem, st_sem, cp_sem, send_sem, recv_sems = refs
+    elif arrival:
         ld_sems, cp_sem, send_sem, recv_sems = refs
         st_sem = None
     else:
@@ -185,6 +200,40 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     dst, sem,
                 ).start()
 
+    def scale_fill():
+        """Wire mode: fetch THIS row block's scale stripe (the trailing
+        lane of the wire image) once per (ring step, i) — the per-row
+        scales are independent of the K tile and the j sweep, so one
+        (tm, LANE) DMA at the first tile of the strip serves every
+        dot of the sweep (re-fetching per tile would put nk*nt-1
+        redundant small DMAs + waits on the consumer path)."""
+        if wire is None:
+            return
+
+        @pl.when(jnp.logical_and(j == 0, kk == 0))
+        def _fill():
+            @pl.when(s == 0)
+            def _own():
+                pltpu.make_async_copy(
+                    a_ref.at[pl.ds(i * tm, tm),
+                             pl.ds(wire[1], wcodec.LANE)],
+                    scale_buf, sc_sem,
+                ).start()
+
+            if n > 1:
+                @pl.when(s > 0)
+                def _remote():
+                    pltpu.make_async_copy(
+                        ws_ref.at[pl.ds(chunk * m_loc + i * tm, tm),
+                                  pl.ds(wire[1], wcodec.LANE)],
+                        scale_buf, sc_sem,
+                    ).start()
+
+            pltpu.make_async_copy(
+                ws_ref.at[pl.ds(0, tm), pl.ds(0, wcodec.LANE)],
+                scale_buf, sc_sem,
+            ).wait()
+
     def a_wait(slot):
         # descriptor only carries the byte count for the semaphore wait
         with trace_ev.span(tctx, R["ag.a_wait"], payload=flat, aux=s):
@@ -192,6 +241,18 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
                 ld_sems.at[slot],
             ).wait()
+
+    def a_dequant(raw):
+        """Consume edge: dequantize the wire A tile right before the
+        MXU dot (per-row f32 scale from the strip's scale stripe)."""
+        if wire is None:
+            return raw
+        fmtw, _k, a_dtype = wire
+        sc = jax.lax.bitcast_convert_type(
+            scale_buf[:, :wcodec.SCALE_BYTES], jnp.float32)
+        if fmtw.kind == "fp8":
+            raw = jax.lax.bitcast_convert_type(raw, jnp.float8_e4m3fn)
+        return (raw.astype(jnp.float32) * sc[:, None]).astype(a_dtype)
 
     # trace init: the first grid step, before any emit below
     @pl.when(jnp.logical_and(flat == 0, s == 0))
@@ -277,8 +338,9 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             i_n = nxt // (nk * nt)
             a_load(i_n, kk_n, jnp.mod(nxt, 2))
 
+        scale_fill()
         a_wait(slot)
-        a_tile = a_buf[slot]
+        a_tile = a_dequant(a_buf[slot])
 
     # --- consumer: this K block's partial product on the MXU. nk > 1
     # accumulates in f32 VMEM scratch; nk == 1 (full-K tile) keeps the
@@ -357,6 +419,7 @@ def ag_gemm(
     force_kernel: bool = False,
     epilogue: Optional[str] = None,
     c_order: str = "rank",
+    wire_format=None,
 ):
     """Overlapped AllGather(a_shard) @ b; per-device function inside shard_map
     (ref host entry: allgather_gemm.py:534-575 `ag_gemm`).
@@ -378,6 +441,18 @@ def ag_gemm(
     (gemm_rs(a_order="arrival"), the TP-MLP down-proj) indexes chunks by
     arrival slot at zero cost. Use arrival_to_rank_order to un-permute
     for order-sensitive consumers.
+
+    wire_format ("fp8"/"int8"/wire.WireFormat, per-row scales only):
+    the AG wire leg moves the block-scaled int8 wire image instead of
+    native A rows — a_shard is encoded ONCE at the send edge (pack),
+    the ring forwards wire bytes on the IDENTICAL semaphore protocol
+    (format-invariant, verifier-proved), and the consumer dequantizes
+    each A tile at the consume edge right before its dot (every row —
+    including the own shard — passes the codec, so the result equals
+    the roundtrip-composed XLA path). ~itemsize x fewer ICI bytes per
+    ring step; drift per wire.numerics. Dense form only (no silu_pair /
+    grouped); K must be lane-aligned. return_gathered returns the
+    DECODED gathered A.
 
     Tracing (trace.building active): one extra trailing output — the
     device trace buffer (ring-step recv waits, per-tile A-load waits,
@@ -423,6 +498,25 @@ def ag_gemm(
         )
     cap_pad = m_loc // e_groups
 
+    fmt = wcodec.resolve(wire_format)
+    wire = not wcodec.is_native(fmt)
+    if wire:
+        if silu_pair or grouped:
+            raise ValueError(
+                "quantized wire supports the dense ag_gemm form only "
+                f"(silu_pair={silu_pair}, grouped={grouped})")
+        if fmt.block is not None:
+            raise ValueError(
+                "ag_gemm wire uses per-row scales (block=None): the "
+                "consumer loads one f32 scale per A row")
+        if k % wcodec.LANE:
+            raise ValueError(
+                f"ag_gemm wire needs lane-aligned K (got {k})")
+        kw = wcodec.wire_cols(k, fmt)
+        aw = wcodec.pack(a_shard, fmt)
+    else:
+        kw, aw = k, a_shard
+
     def _grouped_dot(a_full, w):
         # batched per-expert dot: (E, n*cap, K) x (E, K, N) on the MXU
         xe = jnp.moveaxis(
@@ -437,8 +531,15 @@ def ag_gemm(
         ).reshape(n * m_loc, width)
 
     def xla_path():
-        a_full = (a_shard if n == 1
-                  else jax.lax.all_gather(a_shard, axis, tiled=True))
+        if wire:
+            # the fallback gathers the SAME wire image the kernel
+            # forwards, then decodes — identical wire fidelity
+            a_full_w = (aw if n == 1
+                        else jax.lax.all_gather(aw, axis, tiled=True))
+            a_full = wcodec.unpack(a_full_w, (k,), fmt, a_shard.dtype)
+        else:
+            a_full = (a_shard if n == 1
+                      else jax.lax.all_gather(a_shard, axis, tiled=True))
         dot = _grouped_dot if grouped else (
             lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32))
         if silu_pair:
@@ -482,15 +583,20 @@ def ag_gemm(
     # nk == 1 the dot stores directly) — and the store stage (tm, tn)
     # (x2 window when arrival).
     n_acc = 2 if silu_pair else 1
+    # wire A tiles are int8 (+ a lane-wide scale stripe per slot)
+    a_isz = 1 if wire else itemsize
     vmem_fixed = n_acc * 2 * tk * tn * itemsize \
         + (n_acc * tm * tn * 4 if nk > 1 else 0) \
         + 2 * tm * tn * out_itemsize
     # A strip cache (whole (tm, K) strip, one DMA per block per ring step,
-    # reused across the j sweep) — opt-in via config, see AgGemmConfig.
-    cache_a = (cfg.cache_a and nt >= 2
+    # reused across the j sweep) — opt-in via config, see AgGemmConfig;
+    # the wire consumer keeps the simple double buffer (the strip cache
+    # would have to cache dequantized strips to pay off).
+    cache_a = (cfg.cache_a and nt >= 2 and not wire
                and vmem_fixed + nk * tm * tk * itemsize <= cfg.vmem_budget)
     a_slots = nk if cache_a else 2
-    vmem_need = vmem_fixed + a_slots * tm * tk * itemsize
+    vmem_need = vmem_fixed + a_slots * tm * tk * a_isz \
+        + (tm * wcodec.LANE if wire else 0)
     if (vmem_need > cfg.vmem_budget or interpret_no_headroom()) and (
         not force_kernel
     ):
@@ -512,12 +618,15 @@ def ag_gemm(
         )
     if silu_pair:
         in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec, b_spec]
-        inputs = [a_shard, b_gate, b_up]
+        inputs = [aw, b_gate, b_up]
     else:
         in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec]
-        inputs = [a_shard, b]
+        inputs = [aw, b]
 
-    scratch = [pltpu.VMEM((a_slots, tm, tk), a_shard.dtype)]
+    scratch = [pltpu.VMEM((a_slots, tm, tk),
+                          jnp.int8 if wire else a_shard.dtype)]
+    if wire:  # per-strip scale stripe (one lane of the wire image)
+        scratch.append(pltpu.VMEM((tm, wcodec.LANE), jnp.int8))
     if nk > 1:  # nk==1 stores the dot directly — no accumulator
         scratch.append(pltpu.VMEM((tm, tn), jnp.float32))
         if silu_pair:
@@ -525,6 +634,8 @@ def ag_gemm(
     if not arrival:
         scratch.append(pltpu.VMEM((tm, tn), out_dtype))
     scratch.append(pltpu.SemaphoreType.DMA((a_slots,)))
+    if wire:
+        scratch.append(pltpu.SemaphoreType.DMA)  # sc_sem
     if not arrival:
         scratch.append(pltpu.SemaphoreType.DMA)  # st_sem
     scratch += [
@@ -540,7 +651,8 @@ def ag_gemm(
         if arrival else pl.BlockSpec(memory_space=pl.ANY)
     )
     out_shape = (
-        jax.ShapeDtypeStruct((n * m_loc, k), a_shard.dtype),
+        jax.ShapeDtypeStruct((n * m_loc, kw),
+                             jnp.int8 if wire else a_shard.dtype),
         jax.ShapeDtypeStruct(
             (n * m_loc, i_loc if silu_pair else n_loc), out_dtype
         ),
@@ -558,6 +670,7 @@ def ag_gemm(
                           tm, tn, tk, out_dtype,
                           (cfg.straggler_rank, cfg.straggler_ns),
                           need_ws, cache_a, silu_pair, arrival, grouped,
+                          (fmt, k, a_shard.dtype) if wire else None,
                           build),
         grid=grid,
         out_shape=out_shape,
@@ -582,13 +695,17 @@ def ag_gemm(
         # per-expert width there); the B stack bytes scale with E.
         cost_estimate=cost_estimate(
             flops=2 * n * m_loc * k * n_loc,
-            # C is (n*m_loc, i_loc): half of n_loc in silu_pair mode
-            bytes_accessed=(n * m_loc * k + e_groups * k * n_loc)
-            * itemsize + n * m_loc * i_loc * out_itemsize,
-            remote_bytes=(n - 1) * m_loc * k * itemsize,
+            # C is (n*m_loc, i_loc): half of n_loc in silu_pair mode;
+            # wire legs move kw int8 columns per A row
+            bytes_accessed=n * m_loc * kw * a_isz
+            + e_groups * k * n_loc * itemsize
+            + n * m_loc * i_loc * out_itemsize,
+            remote_bytes=(n - 1) * m_loc * kw * a_isz,
         ),
     )(*inputs)
     ws, c = res[:2]
+    if wire and return_gathered:
+        ws = wcodec.unpack(ws, (k,), fmt, a_shard.dtype)
     tbuf = res[2] if build is not None else None
     return with_trace((c, ws) if return_gathered else c, tbuf)
 
@@ -608,18 +725,25 @@ from triton_dist_tpu import verify as _v  # noqa: E402
 
 
 @_v.protocol("allgather_gemm",
+             grid=({}, {"fmt": "fp8"}),
              doc="AG+GEMM producer ring (_ag_gemm_kernel, need_ws "
-                 "n>1 regime) with the per-ring-step consumer reads")
-def _ag_gemm_protocol(n):
+                 "n>1 regime) with the per-ring-step consumer reads; "
+                 "fmt != native rides the wire image on the same ring")
+def _ag_gemm_protocol(n, fmt="native"):
     """The producer ring of _ag_gemm_kernel: publish the local shard
     into ws[me], forward chunk (me-s) right each step on per-step recv
     semaphores, and CONSUME (GEMM-read) step s's rows only after that
     step's delivery wait — the in-kernel producer/consumer contract the
-    `ag.ring_wait` trace spans measure dynamically."""
+    `ag.ring_wait` trace spans measure dynamically. The wire variant
+    packs a once at the send edge and dequantizes per consumed tile —
+    local dataflow only; the ring skeleton is format-invariant."""
     me = shmem.my_pe(TP_AXIS)
     a, ws = _v.ref("a"), _v.ref("ws")
     cp = _v.sem("cp_sem")
     send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+    if fmt != "native":
+        _v.read(a.at())   # send edge: pack a into the wire image
+        _v.write(a.at())
     shmem.neighbor_barrier(TP_AXIS, me, n)
     _v.read(a.at())  # step-0 consumer reads the own shard from a_ref
     lc = _v.copy(ws.at(me), a.at(), cp.at())

@@ -44,6 +44,7 @@ from triton_dist_tpu.kernels.moe_utils import chunk_group_sizes, silu_mul
 from triton_dist_tpu.lang.core import interpret_no_headroom
 from triton_dist_tpu.runtime.init import EP_AXIS
 from triton_dist_tpu.trace import events as trace_ev
+from triton_dist_tpu.wire import codec as wcodec
 
 
 class EPDispatch(NamedTuple):
@@ -65,9 +66,6 @@ class EPDispatch(NamedTuple):
     drops: jax.Array  # () int32 — (token, choice) pairs beyond capacity
 
 
-_FP8_MAX = 448.0  # e4m3 finite max
-
-
 def _byte_wire(payload_dtype) -> bool:
     """True for the fp8 wire format; rejects unsupported widths loudly
     (a silently-ignored payload_dtype would ship a full-width wire while
@@ -84,13 +82,16 @@ def _byte_wire(payload_dtype) -> bool:
 
 
 def _quantize_fp8(x):
-    """Per-token e4m3 quantization -> (q (M, H) fp8, scale (M,) f32)
+    """Per-token e4m3 quantization -> (q (M, H) fp8, scale (M,) f32).
+
+    THE shared codec definition (wire.quantize at per-row granularity)
+    — this module's original formula moved there verbatim when the wire
+    plane landed (ISSUE 9); the dedupe test pins the payloads bitwise
+    so the repo has exactly one quantization definition
     (ref: the fp8 payload + scale plane of the LL dispatch,
     low_latency_all_to_all.py:36-118)."""
-    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / _FP8_MAX
-    s = jnp.maximum(s, 1e-12)
-    q = (x.astype(jnp.float32) / s[:, None]).astype(jnp.float8_e4m3fn)
-    return q, s
+    q, s = wcodec.quantize(x, wcodec.FP8)
+    return q, s[..., 0]
 
 
 class _Pack(NamedTuple):
@@ -277,8 +278,9 @@ def _decode_payload(recv, h, n, capacity, payload_dtype, out_dtype):
         local_expert = jax.lax.bitcast_convert_type(
             meta[..., 4:], jnp.int32
         ).reshape(n, capacity)
-        tokens = (recv[..., :h].astype(jnp.float32)
-                  * scale[..., None]).astype(out_dtype)
+        # shared codec decode (wire.dequantize): f32 multiply, cast last
+        tokens = wcodec.dequantize(recv[..., :h], scale[..., None],
+                                   wcodec.FP8, out_dtype)
     else:
         tokens = recv[..., :h]
         local_expert = recv[..., h].astype(jnp.int32)

@@ -65,6 +65,7 @@ from triton_dist_tpu.lang.core import (
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.trace import events as trace_ev
+from triton_dist_tpu.wire import codec as wcodec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,11 +150,21 @@ def _partial_chunk_streamed(a_ref, b_ref, chunk, m_loc, tn, a_chunk,
 
 
 def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
-             send_sem, recv_sems, credit_sem, tctx=None):
+             send_sem, recv_sems, credit_sem, tctx=None, fmt=None,
+             ostage=None):
     """The shared producer ring: partial_fn(chunk, dst_ref) fills dst with
     this rank's partial of a global chunk; the ring protocol (credit flow
     control, parity recv semaphores) is reduce_scatter._ring_rs_kernel's,
     with the stage computed instead of loaded.
+
+    `fmt` (wire.WireFormat, quantized): the travelling acc slots hold
+    the block-scaled wire image — partial_fn fills the f32 `stage`,
+    each send edge encodes it into its wire slot, each consume edge
+    decodes + adds in f32, and the final arrival stores WITHOUT a
+    re-encode (via `ostage` when out_dtype != f32). Identical puts /
+    credits / semaphores — the sync skeleton is format-invariant
+    (verify-proved), only the payload bytes and the local VPU dataflow
+    change.
 
     `tctx` (trace.events.TraceCtx or None) gates the event records:
     per-hop credit waits and recv waits (sem_wait class) vs per-chunk
@@ -162,13 +173,26 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
     me = jax.lax.axis_index(axis)
     trace_ev.init_ctx(tctx, rank=me)
     R = trace_ev.REGIONS
+    wirefmt = None if fmt is None or wcodec.is_native(fmt) else fmt
+
+    def final_store(src):
+        st = pltpu.make_async_copy(src, o_ref, st_sem)
+        st.start()
+        st.wait()
 
     if n == 1:
         with trace_ev.span(tctx, R["rs.partial"], payload=0):
-            partial_fn(jnp.int32(0), acc.at[0])
-        st = pltpu.make_async_copy(acc.at[0], o_ref, st_sem)
-        st.start()
-        st.wait()
+            partial_fn(jnp.int32(0), stage if wirefmt else acc.at[0])
+        if wirefmt:
+            # world=1: nothing travels — the send-edge encode still runs
+            # (the measurable codec edge cost), the store is the exact
+            # partial (pass-through semantics, like RS at n == 1)
+            acc[0] = wcodec.encode_rows(stage[...], wirefmt)
+            if ostage is not None:
+                ostage[...] = stage[...].astype(o_ref.dtype)
+            final_store(ostage if ostage is not None else stage)
+        else:
+            final_store(acc.at[0])
         return
 
     left = jnp.mod(me - 1, n)
@@ -188,7 +212,9 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
 
     # Compute our partial of the first travelling chunk, (me-1) mod n.
     with trace_ev.span(tctx, R["rs.partial"], payload=0):
-        partial_fn(jnp.mod(me - 1, n), acc.at[0])
+        partial_fn(jnp.mod(me - 1, n), stage if wirefmt else acc.at[0])
+    if wirefmt:
+        acc[0] = wcodec.encode_rows(stage[...], wirefmt)
 
     for s in range(n - 1):
         cur, nxt = s % 2, (s + 1) % 2
@@ -215,12 +241,24 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
                     device_id_type=pltpu.DeviceIdType.MESH,
                 )
             rdma.wait_recv()
-        acc[nxt] = acc[nxt] + stage[...]
+        if wirefmt:
+            k = stage.shape[-1]
+            val = wcodec.decode_rows(acc[nxt], k, wirefmt, jnp.float32) \
+                + stage[...]
+            if s == n - 2:
+                if ostage is not None:
+                    ostage[...] = val.astype(o_ref.dtype)
+                else:
+                    stage[...] = val  # final arrival: no re-encode
+            else:
+                acc[nxt] = wcodec.encode_rows(val, wirefmt)
+        else:
+            acc[nxt] = acc[nxt] + stage[...]
 
-    final = (n - 1) % 2
-    st = pltpu.make_async_copy(acc.at[final], o_ref, st_sem)
-    st.start()
-    st.wait()
+    if wirefmt:
+        final_store(ostage if ostage is not None else stage)
+    else:
+        final_store(acc.at[(n - 1) % 2])
 
 
 def _src_slot(me, n, chunk, a_arrival):
@@ -231,49 +269,61 @@ def _src_slot(me, n, chunk, a_arrival):
 
 
 def _gemm_rs_kernel(axis: str, n: int, tm: int, out_dtype, straggler,
-                    a_arrival: bool, build, *refs):
-    """Resident regime: b in VMEM, A in (tm, K_loc) tiles."""
+                    a_arrival: bool, fmt, build, *refs):
+    """Resident regime: b in VMEM, A in (tm, K_loc) tiles. `fmt`
+    quantized: partials land in the f32 stage and the ring moves the
+    wire image (see _rs_ring)."""
     refs = list(refs)
     a_ref, b_ref, o_ref = refs[:3]
     del refs[:3]
     tbuf = refs.pop(0) if build is not None else None
     tcur = refs.pop() if build is not None else None
+    wire = fmt is not None and not wcodec.is_native(fmt)
+    ostage = refs.pop(3) if wire and o_ref.dtype != jnp.float32 else None
     (acc, stage, a_tile, ld_sems, st_sem, send_sem, recv_sems,
      credit_sem) = refs
     me = jax.lax.axis_index(axis)
     m_loc = o_ref.shape[0]
+    part_dtype = jnp.float32 if wire else out_dtype
 
     def partial_fn(chunk, dst):
         _partial_chunk(a_ref, b_ref, _src_slot(me, n, chunk, a_arrival),
-                       m_loc, tm, a_tile, dst, ld_sems, out_dtype)
+                       m_loc, tm, a_tile, dst, ld_sems, part_dtype)
 
     _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
              send_sem, recv_sems, credit_sem,
-             tctx=trace_ev.make_ctx(build, tbuf, tcur))
+             tctx=trace_ev.make_ctx(build, tbuf, tcur), fmt=fmt,
+             ostage=ostage)
 
 
 def _gemm_rs_kernel_streamed(axis: str, n: int, tn: int, out_dtype,
-                             straggler, a_arrival: bool, build, *refs):
-    """Streamed regime: A chunk in VMEM, b in (K_loc, tn) column tiles."""
+                             straggler, a_arrival: bool, fmt, build,
+                             *refs):
+    """Streamed regime: A chunk in VMEM, b in (K_loc, tn) column tiles.
+    `fmt` quantized as in _gemm_rs_kernel."""
     refs = list(refs)
     a_ref, b_ref, o_ref = refs[:3]
     del refs[:3]
     tbuf = refs.pop(0) if build is not None else None
     tcur = refs.pop() if build is not None else None
+    wire = fmt is not None and not wcodec.is_native(fmt)
+    ostage = refs.pop(4) if wire and o_ref.dtype != jnp.float32 else None
     (acc, stage, a_chunk, b_tile, a_sem, b_sems, st_sem, send_sem,
      recv_sems, credit_sem) = refs
     me = jax.lax.axis_index(axis)
     m_loc = o_ref.shape[0]
+    part_dtype = jnp.float32 if wire else out_dtype
 
     def partial_fn(chunk, dst):
         _partial_chunk_streamed(
             a_ref, b_ref, _src_slot(me, n, chunk, a_arrival), m_loc, tn,
-            a_chunk, b_tile, a_sem, b_sems, dst, out_dtype,
+            a_chunk, b_tile, a_sem, b_sems, dst, part_dtype,
         )
 
     _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
              send_sem, recv_sems, credit_sem,
-             tctx=trace_ev.make_ctx(build, tbuf, tcur))
+             tctx=trace_ev.make_ctx(build, tbuf, tcur), fmt=fmt,
+             ostage=ostage)
 
 
 def _local_mm_kernel(nk: int, out_dtype, a_ref, b_ref, o_ref, acc=None):
@@ -327,14 +377,22 @@ def gemm_rs(
     out_dtype=None,
     force_kernel: bool = False,
     a_order: str = "rank",
+    wire_format=None,
 ) -> jax.Array:
     """Overlapped ReduceScatter(a @ b); per-device function inside shard_map
     (ref host entry: gemm_reduce_scatter.py:569-583 `gemm_rs`).
 
     a: (M, K_loc); b: (K_loc, N). Returns rank's reduced chunk (M/n, N).
-    out_dtype also sets the cross-rank accumulation dtype in the ring —
-    out_dtype=jnp.float32 is the f32-wire option (doubled hop bytes,
-    exact-sum parity with psum_scatter's f32 accumulation).
+    On the NATIVE wire out_dtype also sets the cross-rank accumulation
+    dtype in the ring — out_dtype=jnp.float32 is the f32-accumulation
+    option (doubled hop bytes as a side effect, exact-sum parity with
+    psum_scatter). wire_format owns the PAYLOAD ENCODING: quantized
+    formats ("fp8"/"int8"/wire.WireFormat) ship the block-scaled wire
+    image per hop and accumulate in f32 at the consume edge regardless
+    of out_dtype (the codec contract) — ~out_itemsize x fewer ICI bytes
+    on the SAME credit/parity protocol (format-invariant,
+    verifier-proved). At world=1 nothing travels: quantized gemm_rs
+    degrades to the plain dot (pass-through, like RS at n == 1).
     a_order="arrival" consumes A whose row blocks are in ag_gemm's
     ring-arrival order (see ag_gemm c_order) by remapping the chunk
     index — free in the kernel, a block un-permute on fallback paths.
@@ -348,6 +406,8 @@ def gemm_rs(
     out_dtype = out_dtype or a.dtype
     assert a_order in ("rank", "arrival"), a_order
     a_arrival = a_order == "arrival"
+    fmt = wcodec.resolve(wire_format)
+    wirefmt = None if wcodec.is_native(fmt) else fmt
     build = trace_ev.active_build()
 
     def with_trace(res, tbuf=None):
@@ -372,8 +432,16 @@ def gemm_rs(
     tm = fit_tile(cfg.tile_m, m_loc)
     in_itemsize = jnp.dtype(a.dtype).itemsize
     out_itemsize = jnp.dtype(out_dtype).itemsize
-    # Ring residents shared by both regimes: acc 2x(m_loc, N) + stage.
-    ring_bytes = 3 * m_loc * n_full * out_itemsize
+    kw = wcodec.wire_cols(n_full, fmt) if wirefmt else 0
+    if wirefmt:
+        # wire acc slots (int8) + f32 stage (+ out-dtype staging buffer
+        # for the final store when out_dtype != f32)
+        ring_bytes = 2 * m_loc * kw + m_loc * n_full * 4
+        if out_dtype != jnp.float32:
+            ring_bytes += m_loc * n_full * out_itemsize
+    else:
+        # Ring residents shared by both regimes: acc 2x(m_loc, N) + stage.
+        ring_bytes = 3 * m_loc * n_full * out_itemsize
     # resident regime adds b plus the A tile double buffer.
     vmem_resident = (
         ring_bytes
@@ -397,22 +465,30 @@ def gemm_rs(
             )
 
             a_ = arrival_to_rank_order(a_, axis)
-        partial = jnp.dot(a_, b, preferred_element_type=jnp.float32).astype(
-            out_dtype
-        )
+        partial = jnp.dot(a_, b, preferred_element_type=jnp.float32)
         if n == 1:
-            return partial
-        return jax.lax.psum_scatter(partial, axis, tiled=True)
+            return partial.astype(out_dtype)
+        if wirefmt:
+            # ppermute replay of the wire ring's exact fold order
+            from triton_dist_tpu.kernels.reduce_scatter import (
+                _wire_rs_xla,
+            )
+
+            return _wire_rs_xla(partial, axis, n, wirefmt).astype(
+                out_dtype)
+        return jax.lax.psum_scatter(partial.astype(out_dtype), axis,
+                                    tiled=True)
 
     if interpret_no_headroom() and not force_kernel:
         _last_regime = "xla"
         return with_trace(xla_path())
 
+    hop_bytes = m_loc * kw if wirefmt else m_loc * n_full * out_itemsize
     cost = cost_estimate(
         flops=2 * m * k_loc * n_full,
         bytes_accessed=(m * k_loc + k_loc * n_full) * in_itemsize
         + m_loc * n_full * out_itemsize,
-        remote_bytes=(n - 1) * m_loc * n_full * out_itemsize,
+        remote_bytes=(n - 1) * hop_bytes,
     )
     cid = next_collective_id(f"gemm_rs_{axis}") if n > 1 else None
 
@@ -431,22 +507,35 @@ def gemm_rs(
             return with_trace(res[0], res[1])
         return res
 
+    def _acc_stage_scratch(extra):
+        """Ring scratch head: acc slots + stage (+ wire ostage), then
+        the regime's own buffers — the order the kernels unpack."""
+        if wirefmt:
+            head = [
+                pltpu.VMEM((2, m_loc, kw), jnp.int8),
+                pltpu.VMEM((m_loc, n_full), jnp.float32),
+            ] + extra
+            if out_dtype != jnp.float32:
+                head.append(pltpu.VMEM((m_loc, n_full), out_dtype))
+            return head
+        return [
+            pltpu.VMEM((2, m_loc, n_full), out_dtype),
+            pltpu.VMEM((m_loc, n_full), out_dtype),
+        ] + extra
+
     if vmem_resident <= cfg.vmem_budget:
         _last_regime = "resident"
         return _ring_call(
             functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype,
                               (cfg.straggler_rank, cfg.straggler_ns),
-                              a_arrival, build),
+                              a_arrival, wirefmt, build),
             jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
             [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pltpu.VMEM),
             ],
             pl.BlockSpec(memory_space=pl.ANY),
-            [
-                pltpu.VMEM((2, m_loc, n_full), out_dtype),
-                pltpu.VMEM((m_loc, n_full), out_dtype),
-                pltpu.VMEM((2, tm, k_loc), a.dtype),
+            _acc_stage_scratch([pltpu.VMEM((2, tm, k_loc), a.dtype)]) + [
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA,
                 pltpu.SemaphoreType.DMA,
@@ -476,18 +565,17 @@ def gemm_rs(
             functools.partial(
                 _gemm_rs_kernel_streamed, axis, n, tn, out_dtype,
                 (cfg.straggler_rank, cfg.straggler_ns), a_arrival,
-                build),
+                wirefmt, build),
             jax.ShapeDtypeStruct((m_loc, n_full), out_dtype),
             [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             pl.BlockSpec(memory_space=pl.ANY),
-            [
-                pltpu.VMEM((2, m_loc, n_full), out_dtype),
-                pltpu.VMEM((m_loc, n_full), out_dtype),
+            _acc_stage_scratch([
                 pltpu.VMEM((m_loc, k_loc), a.dtype),
                 pltpu.VMEM((2, k_loc, tn), b.dtype),
+            ]) + [
                 pltpu.SemaphoreType.DMA,
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA,
@@ -506,7 +594,7 @@ def gemm_rs(
                 # b re-streams once per chunk in this regime
                 bytes_accessed=(m * k_loc + n * k_loc * n_full)
                 * in_itemsize + m_loc * n_full * out_itemsize,
-                remote_bytes=(n - 1) * m_loc * n_full * out_itemsize,
+                remote_bytes=(n - 1) * hop_bytes,
             ),
         )
 
@@ -565,9 +653,11 @@ from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: E402
 
 
 @_v.protocol("gemm_reduce_scatter",
+             grid=({}, {"fmt": "fp8"}, {"fmt": "int8"}),
              doc="GEMM+RS producer ring (_rs_ring): the RS credit ring "
-                 "with the stage filled by the partial GEMM")
-def _gemm_rs_protocol(n):
+                 "with the stage filled by the partial GEMM (fmt != "
+                 "native: wire-image acc slots, same sync skeleton)")
+def _gemm_rs_protocol(n, fmt="native"):
     a, b = _v.ref("a"), _v.ref("b")
 
     def fill_stage(s):
@@ -577,4 +667,4 @@ def _gemm_rs_protocol(n):
         _v.read(a.at())
         _v.read(b.at())
 
-    _ring_rs_skeleton(n, fill_stage)
+    _ring_rs_skeleton(n, fill_stage, fmt=fmt)

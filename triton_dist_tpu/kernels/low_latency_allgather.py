@@ -47,12 +47,23 @@ from triton_dist_tpu.lang.core import (
     tpu_call,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.wire import codec as wcodec
 
 
-def create_ll_ag_buffer(x_shape, dtype, n: int) -> jax.Array:
+def create_ll_ag_buffer(x_shape, dtype, n: int,
+                        wire_format=None) -> jax.Array:
     """Persistent per-device context buffer (2 parities × n slots), the
     FastAllGatherContext analog. Thread it through calls (it is donated /
-    aliased by the kernel)."""
+    aliased by the kernel). With a quantized wire_format the context
+    holds the int8 wire image per slot (the parity protocol is
+    format-invariant — only the slot byte shape changes)."""
+    fmt = wcodec.resolve(wire_format)
+    if not wcodec.is_native(fmt):
+        import math
+
+        rows = x_shape[0]
+        kw = wcodec.wire_cols(math.prod(x_shape[1:]), fmt)
+        return jnp.zeros((2, n, rows, kw), jnp.int8)
     return jnp.zeros((2, n) + tuple(x_shape), dtype)
 
 
@@ -80,6 +91,7 @@ def ll_all_gather(
     call_count,
     axis: str = TP_AXIS,
     first=None,
+    wire_format=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Small-message AG: returns (gathered (n,)+x.shape, new buf).
 
@@ -88,12 +100,30 @@ def ll_all_gather(
     on a fresh context performs the one-time entry barrier — by default
     call 0, overridable via `first` (bool/scalar) when the caller manages
     context lifetime separately from the call counter (ll_all_gather_op).
-    The context must not be shared by two in-flight collectives."""
+    The context must not be shared by two in-flight collectives.
+
+    wire_format: quantized formats push the block-scaled wire image
+    through the SAME parity protocol (the context must have been created
+    with the same format — create_ll_ag_buffer(wire_format=...)); every
+    slot including the rank's own passes the codec, so the gathered
+    result is the pack/unpack roundtrip of the shards."""
     n = jax.lax.axis_size(axis)
+    fmt = wcodec.resolve(wire_format)
+    wire = not wcodec.is_native(fmt)
+
+    def decode(slots):
+        # (n, rows, kw) wire slots -> (n,) + x.shape in x.dtype
+        if not wire:
+            return slots
+        flat = slots.reshape(n * slots.shape[1], slots.shape[2])
+        return wcodec.unpack(flat, x.shape[1:], fmt, x.dtype).reshape(
+            (n,) + x.shape)
+
     if n == 1:
-        return x[None], buf
+        return (wcodec.roundtrip(x, fmt)[None] if wire else x[None]), buf
+    xw = wcodec.pack(x, fmt)
     if interpret_no_headroom():
-        return jax.lax.all_gather(x, axis), buf
+        return decode(jax.lax.all_gather(xw, axis)), buf
 
     call_count = jnp.asarray(call_count, jnp.int32)
     if first is None:
@@ -102,7 +132,8 @@ def ll_all_gather(
         jnp.asarray(call_count % 2, jnp.int32),
         jnp.asarray(first, jnp.int32),
     ])
-    return _ll_ag_call(flags, x, buf, call_count % 2, axis, n)
+    out, buf = _ll_ag_call(flags, xw, buf, call_count % 2, axis, n)
+    return decode(out), buf
 
 
 def _ll_ag_call(flags, x, buf, parity, axis, n):
@@ -131,16 +162,16 @@ def _ll_ag_call(flags, x, buf, parity, axis, n):
 
 
 @functools.lru_cache(maxsize=None)
-def _ll_op_fn(mesh, axis: str):
-    """Cached jitted executable per (mesh, axis): call_count and the
-    fresh-context flag ride as traced arguments, so every decode step
-    replays one compiled program (a fresh closure per call would
-    retrace — the opposite of low-latency)."""
+def _ll_op_fn(mesh, axis: str, fmt=None):
+    """Cached jitted executable per (mesh, axis, wire format):
+    call_count and the fresh-context flag ride as traced arguments, so
+    every decode step replays one compiled program (a fresh closure per
+    call would retrace — the opposite of low-latency)."""
     from jax.sharding import PartitionSpec as P
 
     def per_device(x_shard, buf_shard, cc, first):
         out, new_buf = ll_all_gather(x_shard, buf_shard[0], cc, axis,
-                                     first=first)
+                                     first=first, wire_format=fmt)
         return out, new_buf[None]
 
     return jax.jit(
@@ -161,21 +192,34 @@ def ll_all_gather_op(
     mesh,
     axis: str = TP_AXIS,
     name: str = "ll_ag",
+    wire_format=None,
 ):
     """Host-level LL allgather over a SymmetricWorkspace-owned context
     (the reference's FastAllGatherContext held by a layer context and
     reused across calls, low_latency_allgather.py:781 +
     runtime/symm_mem.SymmetricWorkspace). x is a GLOBAL array sharded
     P(axis); the context buffer persists inside `workspace` between jit
-    invocations (donated in, aliased out, stored back via update())."""
+    invocations (donated in, aliased out, stored back via update()).
+    wire_format: quantized contexts are namespaced per format (a
+    format switch is a fresh context, with its entry barrier)."""
     n = int(mesh.shape[axis])
     loc_rows = x.shape[0] // n
-    local_shape = (2, n, loc_rows) + tuple(x.shape[1:])
+    fmt = wcodec.resolve(wire_format)
+    if wcodec.is_native(fmt):
+        local_shape = (2, n, loc_rows) + tuple(x.shape[1:])
+        buf_dtype = x.dtype
+    else:
+        import math
+
+        kw = wcodec.wire_cols(math.prod(x.shape[1:]), fmt)
+        local_shape = (2, n, loc_rows, kw)
+        buf_dtype = jnp.int8
+        name = f"{name}.{fmt.kind}{fmt.block or ''}"
     # the entry barrier keys off CONTEXT creation, not call_count: a new
     # shape/name at a nonzero count still needs the one-time team sync
-    fresh = not workspace.contains(name, local_shape, x.dtype)
-    buf = workspace.get(name, local_shape, x.dtype)
-    out, new_buf = _ll_op_fn(mesh, axis)(
+    fresh = not workspace.contains(name, local_shape, buf_dtype)
+    buf = workspace.get(name, local_shape, buf_dtype)
+    out, new_buf = _ll_op_fn(mesh, axis, fmt)(
         x, buf, jnp.asarray(call_count, jnp.int32),
         jnp.asarray(fresh, jnp.int32),
     )
@@ -236,11 +280,13 @@ from triton_dist_tpu import verify as _v  # noqa: E402
 
 
 @_v.protocol("low_latency_allgather",
-             grid=({"calls": 1}, {"calls": 3}),
+             grid=({"calls": 1}, {"calls": 3},
+                   {"calls": 3, "fmt": "fp8"}),
              doc="parity double-buffered LL AG: entry barrier on call 0 "
                  "only; calls=3 exercises the same-parity slot reuse "
-                 "(call k+2) the parity counting protocol protects")
-def _ll_ag_protocol(n, calls=3):
+                 "(call k+2) the parity counting protocol protects; "
+                 "fmt != native pushes the wire image on the same slots")
+def _ll_ag_protocol(n, calls=3, fmt="native"):
     """Back-to-back _ll_ag_kernel calls on one context buffer. The
     barrier-free steady state is the point: call k+2 reuses parity
     k%2's slots and semaphores, and its safety rests on the counting
@@ -252,6 +298,10 @@ def _ll_ag_protocol(n, calls=3):
     send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
     for k in range(calls):
         parity = k % 2
+        if fmt != "native":
+            # send edge: pack the shard into the wire image
+            _v.read(x.at())
+            _v.write(x.at())
         if k == 0:
             shmem.barrier_all(TP_AXIS)  # fresh-context entry barrier
         shmem.fcollect_slots(
@@ -259,4 +309,4 @@ def _ll_ag_protocol(n, calls=3):
             lsem.at(), send.at(), recv.at(parity), TP_AXIS, n,
         )
         for j in range(n):
-            _v.read(buf.at(parity, j))  # consume the gathered slots
+            _v.read(buf.at(parity, j))  # consume (wire: per-slot decode)

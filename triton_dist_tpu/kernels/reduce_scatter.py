@@ -7,6 +7,16 @@ TMA variants. On TPU one ring kernel (VMEM-accumulating, double-buffered)
 plus the XLA psum_scatter fallback covers the same space; stage-wise
 composition over two mesh axes is the two-stage inter-node analog
 (ref: reduce_scatter.py:617-672).
+
+Two orthogonal precision knobs (docs/performance.md "Quantized wire"):
+`accum_dtype` is the NATIVE wire's ring accumulation dtype (the
+accumulator IS the RDMA payload there, so f32 accumulation implies a
+2x-byte wire as a side effect); `wire_format` owns the PAYLOAD ENCODING
+— fp8/int8 block-scaled wire images at ~itemsize x fewer hop bytes, with
+consume-edge accumulation fixed at f32 by the codec contract
+(`triton_dist_tpu.wire`). Quantization never changes the semaphore
+protocol: `_ring_rs_wire_kernel` runs the exact credit/parity ring of
+`_ring_rs_kernel`, proven format-invariant by the verifier.
 """
 
 from __future__ import annotations
@@ -30,6 +40,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.wire import codec as wcodec
 
 
 class ReduceScatterMethod(enum.Enum):
@@ -68,10 +79,13 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, x_ref, o_ref, acc,
     Dtype contract: accumulation happens in acc_dtype. The DEFAULT is
     the input dtype — bf16 inputs take n-1 bf16 additions around the
     ring, keeping the ring's bandwidth optimality (the accumulator IS
-    the RDMA payload). acc_dtype=f32 is the f32-wire option (round-4
-    verdict weak #5): every hop ships double the bytes, bought for
-    psum-grade accumulation — the cost is a measured column in
-    benchmark/bench_collectives.py, not an assertion. Loads cast
+    the RDMA payload, so acc_dtype=f32 buys psum-grade ACCUMULATION at
+    the side cost of a doubled-byte hop — the cost is a measured column
+    in benchmark/bench_collectives.py, not an assertion). What the
+    PAYLOAD ENCODING on the wire is belongs to the separate
+    `wire_format` knob (_ring_rs_wire_kernel: block-scaled fp8/int8
+    images, f32 consume-edge accumulation) — the two knobs were
+    conflated before the wire plane; they are orthogonal. Loads cast
     through cast_buf (DMA cannot cast); the output returns in x.dtype.
     """
     me = jax.lax.axis_index(axis)
@@ -81,11 +95,16 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, x_ref, o_ref, acc,
     casting = cast_buf is not None
     shmem.neighbor_barrier(axis, me, n)
 
-    # Step-0 incoming targets our slot 1, free from the start: grant credit.
-    pltpu.semaphore_signal(
-        credit_sem, inc=1, device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
+    # Step-0 incoming targets our slot 1, free from the start: grant
+    # credit. n == 1 (reachable via force_kernel) runs no ring step and
+    # must not leave a dangling credit at kernel exit — a leaked count
+    # in the physical semaphore pool could spuriously satisfy a later
+    # kernel's credit wait (the sem-leak class the verifier flags).
+    if n > 1:
+        pltpu.semaphore_signal(
+            credit_sem, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
 
     def load_chunk(chunk, dst):
         """x[chunk] -> dst(acc_dtype), via cast_buf when dtypes differ.
@@ -142,17 +161,159 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, x_ref, o_ref, acc,
     st.wait()
 
 
+def _ring_rs_wire_kernel(axis: str, n: int, fmt, x_ref, o_ref, acc,
+                         stage, ld_sem, st_sem, send_sem, recv_sem,
+                         credit_sem, cast_buf):
+    """Quantized-wire ring RS: the EXACT credit/parity protocol of
+    `_ring_rs_kernel` — same puts, same per-parity recv semaphores,
+    same credit flow toward the left neighbor (`verify` proves the
+    synchronization skeleton format-invariant) — with the travelling
+    acc slots holding the block-scaled WIRE IMAGE (wire.encode_rows)
+    instead of raw rows. Each hop quantizes at the send edge and
+    accumulates in f32 at the consume edge (decode + add; EQuARX's
+    per-hop requantization): `acc` is int8 (2, m, wire_cols), `stage`
+    the f32 contribution/accumulation buffer, and the LAST arrival is
+    stored without a re-encode, so the output is exactly the f32 fold
+    (wire.simulate_ring_rs replays this order bit-for-bit)."""
+    me = jax.lax.axis_index(axis)
+    m, k = stage.shape
+    left = jnp.mod(me - 1, n)
+    right = jnp.mod(me + 1, n)
+    casting = cast_buf is not None
+    shmem.neighbor_barrier(axis, me, n)
+
+    # see _ring_rs_kernel: no dangling credit at n == 1 (force_kernel)
+    if n > 1:
+        pltpu.semaphore_signal(
+            credit_sem, inc=1, device_id={axis: left},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    def load_chunk(chunk):
+        """x[chunk] -> stage (f32), via cast_buf (DMA cannot cast).
+        Returns a finish() that must run before stage is read."""
+        tgt = cast_buf if casting else stage
+        cp = pltpu.make_async_copy(x_ref.at[pl.ds(chunk * m, m)], tgt,
+                                   ld_sem)
+        cp.start()
+
+        def finish():
+            cp.wait()
+            if casting:
+                stage[...] = cast_buf[...].astype(jnp.float32)
+
+        return finish
+
+    # Our contribution to the first travelling chunk: quantize at the
+    # send edge into the wire slot.
+    load_chunk(jnp.mod(me - 1, n))()
+    acc[0] = wcodec.encode_rows(stage[...], fmt)
+
+    for s in range(n - 1):
+        cur, nxt = s % 2, (s + 1) % 2
+        pltpu.semaphore_wait(credit_sem, 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc.at[cur],
+            dst_ref=acc.at[nxt],
+            send_sem=send_sem,
+            recv_sem=recv_sem.at[nxt],
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        finish = load_chunk(jnp.mod(me - s - 2, n))
+        rdma.wait_send()
+        if s + 1 <= n - 2:
+            pltpu.semaphore_signal(
+                credit_sem, inc=1, device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        rdma.wait_recv()
+        finish()
+        # consume edge: dequantize the incoming hop, accumulate in f32
+        val = wcodec.decode_rows(acc[nxt], k, fmt, jnp.float32) \
+            + stage[...]
+        if s == n - 2:
+            stage[...] = val  # final arrival: no re-encode
+        else:
+            acc[nxt] = wcodec.encode_rows(val, fmt)
+
+    if casting:
+        cast_buf[...] = stage[...].astype(o_ref.dtype)
+        st = pltpu.make_async_copy(cast_buf, o_ref, st_sem)
+    else:
+        st = pltpu.make_async_copy(stage, o_ref, st_sem)
+    st.start()
+    st.wait()
+
+
+def _wire_rs_xla(x: jax.Array, axis: str, n: int, fmt) -> jax.Array:
+    """XLA-collective replay of the quantized ring RS — the SAME fold
+    order as `_ring_rs_wire_kernel` (quantize at each send edge,
+    decode+add in f32, final arrival un-re-encoded), with ppermute
+    carrying the wire image. Used as the no-headroom fallback and by
+    the numerics tests as the mesh-level oracle."""
+    m = x.shape[0] // n
+    xf = x.reshape(x.shape[0], -1).astype(jnp.float32)
+    k = xf.shape[1]
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk(c):
+        return jax.lax.dynamic_slice_in_dim(xf, c * m, m, axis=0)
+
+    val = chunk(jnp.mod(me - 1, n))
+    for s in range(n - 1):
+        w = wcodec.encode_rows(val, fmt)
+        w = jax.lax.ppermute(w, axis, perm)
+        val = wcodec.decode_rows(w, k, fmt, jnp.float32) \
+            + chunk(jnp.mod(me - s - 2, n))
+    return val.astype(x.dtype).reshape((m,) + x.shape[1:])
+
+
 def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
-                        accum_dtype=None) -> jax.Array:
+                        accum_dtype=None, wire_format=None,
+                        force_kernel: bool = False) -> jax.Array:
     """Ring RS of per-device (n*m, ...) -> (m, ...). Call inside shard_map.
 
-    accum_dtype: ring accumulation/wire dtype (default x.dtype; f32 is
-    the psum-parity wire at 2x hop bytes — see _ring_rs_kernel)."""
+    Two orthogonal knobs (they were conflated before the wire plane —
+    see docs/performance.md "Quantized wire"):
+
+    accum_dtype — the ring ACCUMULATION dtype of the native wire
+    (default x.dtype; f32 is the psum-parity accumulation at 2x hop
+    bytes — see _ring_rs_kernel). It does not exist on quantized wires,
+    whose consume-edge accumulation is f32 by construction.
+
+    wire_format — what the PAYLOAD BYTES are on the wire
+    (wire.WireFormat / "fp8" / "int8"; None = native). Quantized
+    formats ship the block-scaled wire image per hop
+    (_ring_rs_wire_kernel) at ~itemsize x fewer ICI bytes; the
+    semaphore protocol is unchanged (format-invariant, verify-proved).
+    Pass accum_dtype=None (or f32) with a quantized wire — any other
+    accumulation dtype would silently contradict the codec's f32
+    contract, so it raises.
+
+    force_kernel skips the n == 1 early return so the kernel's
+    world=1 edge cost is measurable (bench.py wire arms)."""
     n = jax.lax.axis_size(axis)
     if x.shape[0] % n != 0:
         raise ValueError(f"leading dim {x.shape[0]} not divisible by {n}")
+    fmt = wcodec.resolve(wire_format)
+    if not wcodec.is_native(fmt):
+        if accum_dtype is not None and \
+                jnp.dtype(accum_dtype) != jnp.float32:
+            raise ValueError(
+                "quantized wire accumulates in f32 at the consume edge "
+                "by construction; accum_dtype is the NATIVE wire's ring "
+                f"accumulation knob — got accum_dtype={accum_dtype!r} "
+                f"with wire_format={fmt}")
+        if x.ndim < 2:
+            raise ValueError(
+                f"quantized wire needs >=2D per-device arrays, got "
+                f"{x.shape}")
+        return _ring_rs_quantized(x, axis, n, fmt, force_kernel)
     acc_dtype = jnp.dtype(accum_dtype or x.dtype)
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     if interpret_no_headroom():
         if acc_dtype != x.dtype:
@@ -197,26 +358,91 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
     )(x)
 
 
+def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
+                       force_kernel: bool) -> jax.Array:
+    """Dispatch the quantized-wire ring: the Pallas kernel when the
+    interpreter has headroom (or on hardware), else the ppermute replay
+    of the identical fold. n == 1 is a pass-through (no hop ever
+    travels; the kernel still pays the send-edge encode when forced,
+    which is what the bench's world=1 wire arm measures)."""
+    if n == 1 and not force_kernel:
+        return x
+    if interpret_no_headroom():
+        if n == 1:
+            return x
+        return _wire_rs_xla(x, axis, n, fmt)
+    m = x.shape[0] // n
+    flat = x.reshape(x.shape[0], -1)
+    k = flat.shape[1]
+    kw = wcodec.wire_cols(k, fmt)
+    casting = x.dtype != jnp.float32
+    kernel = functools.partial(_ring_rs_wire_kernel, axis, n, fmt)
+    if not casting:
+        inner = kernel
+
+        def kernel(*args):  # noqa: F811
+            return inner(*args, None)
+
+    scratch = [
+        pltpu.VMEM((2, m, kw), jnp.int8),     # travelling wire slots
+        pltpu.VMEM((m, k), jnp.float32),      # f32 stage/accumulator
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR,
+    ]
+    if casting:
+        scratch.append(pltpu.VMEM((m, k), x.dtype))
+    out = tpu_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, k), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch,
+        compiler_params=compiler_params(
+            has_side_effects=True,
+            collective_id=next_collective_id(
+                f"ring_rs_wire_{fmt.kind}_{axis}"),
+            vmem_limit_bytes=min(
+                128 << 20,
+                3 * compute_vmem_bytes(((m, k), jnp.float32),
+                                       ((2, m, kw), jnp.int8))),
+        ),
+    )(flat)
+    return out.reshape((m,) + x.shape[1:])
+
+
 def reduce_scatter(
     x: jax.Array,
     axis: Union[str, Sequence[str]] = TP_AXIS,
     method: ReduceScatterMethod = ReduceScatterMethod.Auto,
     accum_dtype=None,
+    wire_format=None,
 ) -> jax.Array:
     """Reduce-scatter per-device (n*m, ...) -> (m, ...); per-device function.
 
     Axis tuples run stage-wise outermost-first (the two-stage per-node path
     of ref reduce_scatter.py:617-672): RS over the slow axis first so the
     fast-axis stage reduces already-combined super-chunks.
-    accum_dtype: ring wire/accumulation dtype (see ring_reduce_scatter).
+    accum_dtype: the NATIVE wire's ring accumulation dtype;
+    wire_format: the payload encoding on the wire (fp8/int8 block-scaled
+    wire image, f32 consume-edge accumulation) — two separate knobs, see
+    ring_reduce_scatter.
     """
     if not isinstance(axis, str):
         out = x
         for ax in tuple(axis):
             out = reduce_scatter(out, ax, method=method,
-                                 accum_dtype=accum_dtype)
+                                 accum_dtype=accum_dtype,
+                                 wire_format=wire_format)
         return out
 
+    if not wcodec.is_native(wire_format):
+        # the quantized ring owns its own fallback routing (the XLA
+        # psum_scatter cannot express per-hop requantization)
+        return ring_reduce_scatter(x, axis, accum_dtype=accum_dtype,
+                                   wire_format=wire_format)
     if method == ReduceScatterMethod.Auto:
         n = jax.lax.axis_size(axis)
         chunk_bytes = (x.size // n) * x.dtype.itemsize
@@ -238,24 +464,27 @@ def reduce_scatter_op(
     mesh,
     axis: str = TP_AXIS,
     method: ReduceScatterMethod = ReduceScatterMethod.Auto,
+    wire_format=None,
 ) -> jax.Array:
     """Host-level RS. `arr` stacks per-rank contributions: (n, n*m, ...),
     sharded on dim 0 — rank r contributes arr[r] and keeps sum chunk r
     (ref op contract: reduce_scatter.py:857-866). Returns (n*m, ...) sharded
-    along the leading dim."""
+    along the leading dim. wire_format as in reduce_scatter."""
     n = int(mesh.shape[axis])
     if arr.shape[0] != n:
         raise ValueError(
             f"reduce_scatter_op expects one stacked contribution per rank: "
             f"leading dim {arr.shape[0]} != axis size {n}"
         )
-    return _rs_op_jit(mesh, axis, method)(arr)
+    return _rs_op_jit(mesh, axis, method,
+                      wcodec.resolve(wire_format))(arr)
 
 
 @functools.lru_cache(maxsize=None)
-def _rs_op_jit(mesh, axis: str, method: ReduceScatterMethod):
+def _rs_op_jit(mesh, axis: str, method: ReduceScatterMethod, fmt):
     def fn(xs):
-        return reduce_scatter(xs[0], axis, method=method)
+        return reduce_scatter(xs[0], axis, method=method,
+                              wire_format=fmt)
 
     return jax.jit(
         jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
@@ -268,20 +497,30 @@ def _rs_op_jit(mesh, axis: str, method: ReduceScatterMethod):
 from triton_dist_tpu import verify as _v  # noqa: E402
 
 
-def _ring_rs_skeleton(n, fill_stage, prefix=""):
+def _ring_rs_skeleton(n, fill_stage, prefix="", fmt="native"):
     """The shared RS producer ring protocol (_ring_rs_kernel /
-    gemm_reduce_scatter._rs_ring): credit flow control toward the left
-    neighbor, parity-indexed recv semaphores, double-buffered acc slots.
-    `fill_stage(s)` supplies the per-step stage fill — an async x-chunk
-    load here, a synchronous partial-GEMM write in the fused kernel —
-    so both kernels share ONE verified skeleton, exactly as they share
-    the runtime ring.
+    _ring_rs_wire_kernel / gemm_reduce_scatter._rs_ring): credit flow
+    control toward the left neighbor, parity-indexed recv semaphores,
+    double-buffered acc slots. `fill_stage(s)` supplies the per-step
+    stage fill — an async x-chunk load here, a synchronous partial-GEMM
+    write in the fused kernel — so all three kernels share ONE verified
+    skeleton, exactly as they share the runtime ring.
+
+    `fmt` mirrors the wire_format knob: the quantized variants carry
+    the block-scaled wire image in the SAME acc slots on the SAME
+    semaphores — only the LOCAL dataflow differs (each consume edge
+    decodes + re-encodes, and the final arrival is staged un-re-encoded
+    before the store). The synchronization skeleton is identical by
+    construction AND by proof: `verify.protocol_skeleton` re-derives it
+    per format and `registry.check_format_invariance` asserts equality
+    (docs/verification.md "Format invariance").
 
     The credit protocol is what makes the acc slot reuse safe: the
     verifier proves it by the HB chain my wait_send -> my credit grant
     -> left's credit wait -> left's next put into that slot (drop the
     credits and the race detector fires — tests/_mutants.py
     rs_ring_no_credit)."""
+    wire = fmt != "native"
     me = shmem.my_pe(TP_AXIS)
     o = _v.ref(prefix + "o")
     acc, stage = _v.ref(prefix + "acc"), _v.ref(prefix + "stage")
@@ -294,6 +533,8 @@ def _ring_rs_skeleton(n, fill_stage, prefix=""):
     shmem.signal(credit.at(), 1, shmem.SIGNAL_ADD, left, TP_AXIS)
     # our contribution to the first travelling chunk -> acc[0]
     fill_stage(-1)
+    if wire:
+        _v.read(stage.at())  # send-edge encode reads the f32 stage
     _v.write(acc.at(0))
     for s in range(n - 1):
         cur, nxt = s % 2, (s + 1) % 2
@@ -309,14 +550,21 @@ def _ring_rs_skeleton(n, fill_stage, prefix=""):
         h.wait_recv()
         _v.read(stage.at())
         _v.read(acc.at(nxt))
-        _v.write(acc.at(nxt))  # acc[nxt] += stage
-    fc = _v.copy(o.at(), acc.at((n - 1) % 2), st.at())
+        if wire and s == n - 2:
+            _v.write(stage.at())  # final arrival: staged, no re-encode
+        else:
+            _v.write(acc.at(nxt))  # acc[nxt] += stage (native) / encode
+    final_src = stage.at() if wire else acc.at((n - 1) % 2)
+    fc = _v.copy(o.at(), final_src, st.at())
     fc.wait()
 
 
 @_v.protocol("reduce_scatter",
-             doc="credit-flow ring RS (_ring_rs_kernel)")
-def _rs_protocol(n, prefix=""):
+             grid=({}, {"fmt": "fp8"}, {"fmt": "int8"}),
+             doc="credit-flow ring RS (_ring_rs_kernel; fmt != native "
+                 "models _ring_rs_wire_kernel — same sync skeleton, "
+                 "wire-image acc slots)")
+def _rs_protocol(n, prefix="", fmt="native"):
     x = _v.ref(prefix + "x")
     ld = _v.sem(prefix + "ld_sem")
 
@@ -324,8 +572,8 @@ def _rs_protocol(n, prefix=""):
         # async load of our contribution; finish() runs before the read
         me = shmem.my_pe(TP_AXIS)
         chunk = (me - 1) % n if s < 0 else (me - s - 2) % n
-        dst = (_v.ref(prefix + "acc").at(0) if s < 0
+        dst = (_v.ref(prefix + "acc").at(0) if s < 0 and fmt == "native"
                else _v.ref(prefix + "stage").at())
         _v.copy(dst, x.at(chunk), ld.at()).wait()
 
-    _ring_rs_skeleton(n, fill_stage, prefix=prefix)
+    _ring_rs_skeleton(n, fill_stage, prefix=prefix, fmt=fmt)

@@ -307,6 +307,136 @@ def estimate_ar_ms(
     )
 
 
+# -- quantized-wire models (ISSUE 9: bytes-by-precision rooflines) ----------
+
+# HBM passes a quantized wire adds at each codec edge: the encode reads
+# the f32 value and writes the wire image; the decode reads the image
+# and folds into the f32 accumulator. Conservative (VPU math rides the
+# same passes); what matters is that the codec term scales with the
+# NATIVE bytes while the wire term scales with the packed bytes, so
+# native wins when there is no ICI to save (n small) and quantized wins
+# once the hop term dominates — the crossover choose_wire_format walks.
+WIRE_CODEC_PASSES = 3.0
+
+# Cosine-drift bases per format kind, calibrated on the numerics
+# harness (wire.numerics.collective_drift, H=512 per-row blocks, normal
+# data): one gather-family encode/decode roundtrip. fp8 e4m3 carries
+# ~3.5 significant bits -> ~3.5e-4; int8's 7+sign bits land ~3e-5.
+WIRE_DRIFT_BASE = {"fp8": 3.5e-4, "int8": 3.0e-5}
+# Reduction rings requantize per hop; measured drift grows ~sqrt(hops)
+# with this calibrated prefactor (fp8 two-shot AR at n=8 measured
+# ~1.5e-3 = base * sqrt(7) * 1.7).
+WIRE_HOP_DRIFT_FACTOR = 1.7
+
+_REDUCTION_COLLECTIVES = ("allreduce", "reduce_scatter",
+                          "gemm_reduce_scatter")
+
+
+def wire_shrink(dtype, fmt, row_width: int = 512) -> float:
+    """Wire bytes / native bytes for rows of `row_width` elements in
+    `dtype` under wire format `fmt` (1.0 for native). The packed image
+    is 1 byte/element plus the bitcast f32 scales plus lane padding —
+    wire.wire_row_bytes is the exact ledger; this is its ratio."""
+    from triton_dist_tpu.wire import codec as wcodec
+
+    f = wcodec.resolve(fmt)
+    native = row_width * _dtype_bytes(dtype)
+    return wcodec.wire_row_bytes(row_width, f, dtype) / native
+
+
+def estimate_wire_drift(fmt, n: int = 1,
+                        collective: str = "allgather") -> float:
+    """Modeled cosine drift of one (collective, format) execution vs the
+    f32/native wire — the admissibility side of choose_wire_format.
+    Gather-family collectives pay one roundtrip; reduction rings pay a
+    per-hop requantization chain growing ~sqrt(n-1). Conservative
+    (per-row scale granularity — finer blocks only lower it); the
+    harness (wire.numerics) is the measured ground truth this model is
+    calibrated on."""
+    from triton_dist_tpu.wire import codec as wcodec
+
+    f = wcodec.resolve(fmt)
+    if f.kind == "native":
+        return 0.0
+    base = WIRE_DRIFT_BASE[f.kind]
+    if collective in _REDUCTION_COLLECTIVES and n > 1:
+        return base * WIRE_HOP_DRIFT_FACTOR * max(n - 1, 1) ** 0.5
+    return base
+
+
+def estimate_collective_wire_ms(
+    collective: str,
+    nbytes: int,
+    n: int,
+    dtype=jnp.bfloat16,
+    fmt=None,
+    chip: Optional[ChipSpec] = None,
+    row_width: int = 512,
+) -> float:
+    """Roofline of one collective under a wire format: the ICI term at
+    the format's bytes-by-precision (wire_shrink) plus the codec edge
+    passes over HBM (WIRE_CODEC_PASSES x the native bytes, zero for
+    native). `nbytes` is the NATIVE payload: per-device full tensor for
+    allreduce/reduce_scatter, per-rank shard for the gather family.
+    Ranks formats for choose_wire_format; does not promise wall-clock."""
+    chip = chip or detect_chip()
+    shrink = wire_shrink(dtype, fmt, row_width)
+    wb = int(nbytes * shrink)
+    if collective == "allreduce":
+        wire_ms = estimate_ar_ms(wb, n, chip, method="two_shot")
+    elif collective == "reduce_scatter":
+        wire_ms = estimate_rs_ms(wb, n, chip)
+    elif collective in ("allgather", "low_latency_allgather",
+                        "allgather_gemm"):
+        wire_ms = estimate_ag_ms(wb, n, chip)
+    elif collective == "gemm_reduce_scatter":
+        wire_ms = estimate_rs_ms(wb, n, chip)
+    else:
+        raise ValueError(f"unknown collective {collective!r}")
+    from triton_dist_tpu.wire import codec as wcodec
+
+    if wcodec.is_native(fmt):
+        return wire_ms  # no codec edges on the native wire
+    codec_ms = WIRE_CODEC_PASSES * nbytes / (chip.hbm_gbps * 1e9) * 1e3
+    return wire_ms + codec_ms
+
+
+def choose_wire_format(
+    nbytes: int,
+    n: int,
+    dtype=jnp.bfloat16,
+    error_budget: Optional[float] = None,
+    collective: str = "allreduce",
+    formats=("fp8", "int8"),
+    chip: Optional[ChipSpec] = None,
+    row_width: int = 512,
+):
+    """The budget-gated wire selector: among `formats` whose modeled
+    drift (estimate_wire_drift) clears `error_budget` — plus native,
+    always admissible — pick the cheapest by the bytes-by-precision
+    roofline (estimate_collective_wire_ms). error_budget=None uses
+    wire.DEFAULT_ERROR_BUDGET; 0.0 forces native. Ties favor native
+    (quantization is never free in fidelity). Returns a
+    wire.WireFormat — pass it straight to the collective's
+    wire_format= knob."""
+    from triton_dist_tpu.wire import codec as wcodec
+    from triton_dist_tpu.wire.numerics import DEFAULT_ERROR_BUDGET
+
+    budget = DEFAULT_ERROR_BUDGET if error_budget is None else error_budget
+    chip = chip or detect_chip()
+    cands = [wcodec.NATIVE] + [
+        wcodec.resolve(f) for f in formats
+        if estimate_wire_drift(f, n, collective) <= budget
+    ]
+    best = min(cands, key=lambda f: estimate_collective_wire_ms(
+        collective, nbytes, n, dtype, f, chip, row_width))
+    native_ms = estimate_collective_wire_ms(
+        collective, nbytes, n, dtype, wcodec.NATIVE, chip, row_width)
+    best_ms = estimate_collective_wire_ms(
+        collective, nbytes, n, dtype, best, chip, row_width)
+    return wcodec.NATIVE if best_ms >= native_ms else best
+
+
 def estimate_a2a_ms(
     nbytes_per_peer: int,
     n: int,

@@ -63,6 +63,7 @@ from triton_dist_tpu.trace.attribution import (  # noqa: F401
     per_region,
     prefetch_hit_rate,
     task_time_by_branch,
+    wire_send_bytes,
 )
 from triton_dist_tpu.trace.export import (  # noqa: F401
     group_profile,

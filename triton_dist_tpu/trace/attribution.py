@@ -170,6 +170,30 @@ def fp_seg_waits(tl: Timeline, stream: str) -> Dict[int, np.ndarray]:
 # -- megakernel measured-vs-predicted ----------------------------------------
 
 
+def wire_send_bytes(tl: Timeline, stream: str, region: str,
+                    bytes_per_event: int) -> Dict[int, int]:
+    """Per-rank WIRE bytes attributed to one transport region: the
+    count of that region's records (spans and instants both — kernels
+    mark sends as instants, delivery waits as spans) priced at
+    `bytes_per_event`. With bytes_per_event =
+    `wire.wire_row_bytes(h, fmt, dtype) * rows_per_transfer`, this is
+    the per-format byte ledger of a transport leg: the SAME traced
+    kernel run under native vs fp8 wire attributes bytes in exactly the
+    packed ratio (the protocol — and therefore the event count — is
+    format-invariant; only the per-event byte price moves). Returns
+    {rank: bytes}."""
+    rid = ev.region_id(region)
+    out: Dict[int, int] = {}
+    for s in tl.spans:
+        if s.stream == stream and s.region == rid:
+            out[s.rank] = out.get(s.rank, 0) + int(bytes_per_event)
+    for e in tl.events:
+        if (e.stream == stream and e.region == rid
+                and e.kind == ev.KIND_INSTANT):
+            out[e.rank] = out.get(e.rank, 0) + int(bytes_per_event)
+    return out
+
+
 def compare_predicted(sched, tl: Timeline, stream: str = "mega",
                       graph=None, tol: float = 0.1,
                       check: bool = True) -> List[dict]:

@@ -58,11 +58,15 @@ from triton_dist_tpu.verify.engine import (  # noqa: F401
     check_races,
     concretize,
     execute,
+    protocol_skeleton,
     run_protocol,
 )
 from triton_dist_tpu.verify.hb import CycleError, HBGraph  # noqa: F401
 from triton_dist_tpu.verify.registry import (  # noqa: F401
+    FORMAT_PARAM,
     ProtocolSpec,
+    check_format_invariance,
+    format_parameterized,
     load_shipped,
     mutant,
     mutants,

@@ -448,6 +448,45 @@ def check_races(ex: Execution) -> List[Finding]:
     return out
 
 
+# Op kinds that constitute the SYNCHRONIZATION skeleton of a protocol:
+# remote puts (with their semaphore slots and peers), signals, waits,
+# and barriers. Local dataflow (COPY/READ/WRITE annotations — e.g. a
+# wire codec's encode/decode at the send/consume edges) is deliberately
+# excluded: the quantized-wire invariant is exactly that payload
+# encoding changes local dataflow and byte counts but NEVER this
+# skeleton (docs/verification.md "Format invariance").
+PROTOCOL_KINDS = (cap.PUT, cap.SIGNAL, cap.WAIT, cap.WAIT_SEND,
+                  cap.WAIT_RECV, cap.BARRIER)
+
+# the skeleton fields per kind — buffer refs (src/dst) are excluded on
+# purpose (a wire variant may stage through a differently-named buffer;
+# the semaphore protocol is the invariant)
+_SKELETON_FIELDS = ("send_sem", "recv_sem", "sem", "pe", "amount",
+                    "round")
+
+
+def protocol_skeleton(fn, n: int, **params):
+    """The concretized synchronization skeleton of fn(n, **params): a
+    tuple (one entry per rank) of (kind, sorted protocol fields) tuples
+    over PROTOCOL_KINDS only. Two parameterizations of a protocol whose
+    skeletons are equal perform the same puts on the same semaphore
+    slots toward the same peers, the same waits/amounts and the same
+    barrier structure — the theorem `registry.check_format_invariance`
+    asserts across wire formats."""
+    with cap.capturing(n) as c:
+        fn(n, **params)
+    progs = concretize(c.ops, n)
+    return tuple(
+        tuple(
+            (op.kind, tuple(sorted(
+                (f, v) for f, v in op.f.items()
+                if f in _SKELETON_FIELDS)))
+            for op in prog if op.kind in PROTOCOL_KINDS
+        )
+        for prog in progs
+    )
+
+
 def run_protocol(fn, n: int, **params) -> Execution:
     """Capture fn(n, **params) symbolically, concretize at n, execute,
     and attach the race findings. The one-stop entry the registry

@@ -115,6 +115,69 @@ def verify_spec(spec: ProtocolSpec) -> List[engine.Finding]:
     return out
 
 
+# the grid parameter that selects a wire format in format-parameterized
+# protocol models (the kernels' wire_format= knob, spelled `fmt` in the
+# models so grids stay terse)
+FORMAT_PARAM = "fmt"
+
+
+def format_parameterized() -> Dict[str, ProtocolSpec]:
+    """The shipped protocols whose grid carries a FORMAT_PARAM entry —
+    the wire-converted collectives."""
+    return {name: spec for name, spec in load_shipped().items()
+            if any(FORMAT_PARAM in g for g in spec.grid)}
+
+
+def check_format_invariance(names=None) -> List[str]:
+    """Prove the quantized-wire invariant for every format-parameterized
+    protocol: at each team size and each base parameterization, the
+    synchronization skeleton (engine.protocol_skeleton — puts, signals,
+    waits, barriers with their semaphore slots, peers and amounts) is
+    IDENTICAL across every wire format the grid names, native included.
+    Returns problem strings (empty = invariant holds). A protocol whose
+    wire variant needs a different semaphore structure must consciously
+    drop its FORMAT_PARAM grid entries — this check makes that a loud
+    decision instead of a silent drift."""
+    reg = format_parameterized()
+    if names:
+        reg = {k: v for k, v in reg.items() if k in names}
+    problems: List[str] = []
+    for name in sorted(reg):
+        spec = reg[name]
+        # group grid entries by the non-format params: each group is one
+        # base parameterization swept over formats (+ implicit native)
+        groups: Dict[tuple, list] = {}
+        for g in spec.grid:
+            base = tuple(sorted((k, v) for k, v in g.items()
+                                if k != FORMAT_PARAM))
+            fmt = g.get(FORMAT_PARAM, "native")
+            groups.setdefault(base, [])
+            if fmt not in groups[base]:
+                groups[base].append(fmt)
+        for base, fmts in groups.items():
+            if "native" not in fmts:
+                fmts.insert(0, "native")
+            if len(fmts) < 2:
+                continue
+            for n in spec.ns:
+                skels = {}
+                for fmt in fmts:
+                    params = dict(base)
+                    if fmt != "native":
+                        params[FORMAT_PARAM] = fmt
+                    skels[fmt] = engine.protocol_skeleton(
+                        spec.fn, n, **params)
+                ref_fmt = fmts[0]
+                for fmt in fmts[1:]:
+                    if skels[fmt] != skels[ref_fmt]:
+                        problems.append(
+                            f"{name} n={n} {dict(base)}: sync skeleton "
+                            f"of fmt={fmt!r} differs from "
+                            f"fmt={ref_fmt!r} — quantization must not "
+                            "change the semaphore protocol")
+    return problems
+
+
 def verify_shipped(names=None) -> List[engine.Finding]:
     """Run the verifier over every shipped collective's protocol model
     (the `scripts/verify_kernels.py` core). Empty list == all proven

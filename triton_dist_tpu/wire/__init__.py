@@ -1,0 +1,60 @@
+"""triton_dist_tpu.wire — block-scaled quantized-wire codec plane.
+
+Every ICI-bound collective in this framework can trade precision for
+wire bytes under an explicit error budget (the EQuARX direction,
+arXiv 2506.17615): the `wire_format=` knob on the two-shot allreduce,
+the ring/full-mesh/low-latency allgathers, and the fused AG+GEMM /
+GEMM+RS wire legs quantizes at the send edge, dequantizes at the
+consume edge, and accumulates in f32 — changing payload bytes but
+NEVER the semaphore protocol (proved format-invariant by
+`verify.protocol_skeleton`; docs/verification.md).
+
+  codec      WireFormat ("native" | "fp8" | "int8", block-scaled f32
+             scales riding the metadata-row idiom), quantize/dequantize,
+             the int8 wire image (encode_rows/decode_rows, pack/unpack)
+             usable at jnp level and inside Pallas kernel bodies.
+  numerics   the ulp/cosine drift harness per (collective, format),
+             replaying each kernel's exact fold order; the calibration
+             source for perf_model.estimate_wire_drift and the
+             DEFAULT_ERROR_BUDGET gate.
+
+`perf_model.choose_wire_format` picks the fastest format whose modeled
+drift clears the caller's error budget; docs/performance.md "Quantized
+wire" has the bytes-by-precision rooflines and the measured columns.
+"""
+
+from triton_dist_tpu.wire.codec import (  # noqa: F401
+    FP8,
+    FP8_MAX,
+    INT8,
+    INT8_MAX,
+    LANE,
+    NATIVE,
+    SCALE_BYTES,
+    SCALE_EPS,
+    WireFormat,
+    decode_rows,
+    dequantize,
+    encode_rows,
+    is_native,
+    n_blocks,
+    pack,
+    payload_dtype,
+    quantize,
+    resolve,
+    roundtrip,
+    unpack,
+    wire_cols,
+    wire_row_bytes,
+)
+from triton_dist_tpu.wire.numerics import (  # noqa: F401
+    DEFAULT_ERROR_BUDGET,
+    codec_drift,
+    collective_drift,
+    cosine_drift,
+    drift_monotone_in_block,
+    drift_table,
+    max_ulp_f32,
+    simulate_allreduce,
+    simulate_ring_rs,
+)

@@ -1,0 +1,265 @@
+"""Block-scaled quantized-wire codecs — the one quantization definition.
+
+The repo proved the quantized-wire pattern twice in isolation before
+this subsystem existed: the fp8 payload + f32 scale plane of the EP
+dispatch (`kernels/ep_a2a.py`, the reference's latency-class wire) and
+the `accum_dtype` f32-wire knob of `kernels/reduce_scatter.py`. EQuARX
+(arXiv 2506.17615) shows the generalization pays: quantizing the
+AllReduce wire inside the runtime buys ~2x wire bytes at negligible
+model-quality cost. This module is that generalization's codec plane:
+
+  WireFormat     "native" (pass-through — payload bytes ARE the tensor),
+                 "fp8" (e4m3), "int8" — each quantized format block-
+                 scaled along the last axis with f32 scales.
+  quantize /     the (payload, scale) pair. The fp8 per-row path is
+  dequantize     BITWISE the legacy ep_a2a formula (pinned by
+                 tests/test_wire.py::test_fp8_matches_legacy_ep_formula)
+                 — the repo has exactly one quantization definition.
+  encode_rows /  the WIRE IMAGE: one int8 (rows, wire_cols) array with
+  decode_rows    the f32 scales bitcast into trailing byte columns and
+                 the row lane-padded to 128 — the ep_a2a metadata-row
+                 idiom, generalized. Pure jnp, so the same functions run
+                 at host level (pack an array before a transport kernel)
+                 AND inside Pallas kernel bodies (encode a VMEM value at
+                 the send edge, decode at the consume edge).
+  pack / unpack  host-level wrappers flattening trailing dims.
+
+The load-bearing invariant of every consumer kernel: a wire format
+changes PAYLOAD BYTES ONLY — never the semaphore protocol. Transport
+kernels move the wire image exactly as they move native rows (same
+puts, same delivery semaphores, same credits); `verify` proves the
+synchronization skeleton format-invariant (`verify.protocol_skeleton`,
+docs/verification.md "Format invariance").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+# e4m3 finite max (the legacy ep_a2a constant) / int8 symmetric max.
+FP8_MAX = 448.0
+INT8_MAX = 127.0
+# scale floor — keeps all-zero blocks finite (legacy ep_a2a constant)
+SCALE_EPS = 1e-12
+SCALE_BYTES = 4  # one f32 scale per block
+LANE = 128       # TPU lane width; wire rows pad to a multiple
+
+_KINDS = ("native", "fp8", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFormat:
+    """One wire encoding: `kind` picks the payload dtype, `block` the
+    scale granularity along the (flattened) last axis — None means one
+    scale per row (the legacy ep_a2a per-token scheme); an int block
+    must divide the row width. Hashable/frozen so it can ride jit
+    closure keys and autotuner cache keys."""
+
+    kind: str = "native"
+    block: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown wire format kind {self.kind!r} (one of {_KINDS})")
+        if self.block is not None and self.block <= 0:
+            raise ValueError(f"wire block must be positive, got {self.block}")
+
+
+NATIVE = WireFormat("native")
+FP8 = WireFormat("fp8")
+INT8 = WireFormat("int8")
+
+WireFormatLike = Union[None, str, WireFormat]
+
+
+def resolve(fmt: WireFormatLike) -> WireFormat:
+    """None/str/WireFormat -> WireFormat (None and "native" are the
+    pass-through format)."""
+    if fmt is None:
+        return NATIVE
+    if isinstance(fmt, WireFormat):
+        return fmt
+    if isinstance(fmt, str):
+        return WireFormat(fmt)
+    raise TypeError(f"wire_format must be None/str/WireFormat, got "
+                    f"{type(fmt).__name__}")
+
+
+def is_native(fmt: WireFormatLike) -> bool:
+    return resolve(fmt).kind == "native"
+
+
+def payload_dtype(fmt: WireFormatLike):
+    f = resolve(fmt)
+    if f.kind == "fp8":
+        return jnp.float8_e4m3fn
+    if f.kind == "int8":
+        return jnp.int8
+    raise ValueError("native wire has no quantized payload dtype")
+
+
+def _fmax(fmt: WireFormat) -> float:
+    return FP8_MAX if fmt.kind == "fp8" else INT8_MAX
+
+
+def n_blocks(h: int, fmt: WireFormatLike) -> int:
+    """Scale blocks per row of width h (block must divide h)."""
+    f = resolve(fmt)
+    if f.block is None:
+        return 1
+    if h % f.block:
+        raise ValueError(
+            f"wire block {f.block} does not divide row width {h}")
+    return h // f.block
+
+
+def wire_cols(h: int, fmt: WireFormatLike) -> int:
+    """Wire-image row width (int8 columns) for a logical row of h
+    elements: payload bytes + bitcast f32 scales, padded to the lane
+    width. Native format has no wire image (raises)."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        raise ValueError("native wire has no packed image; move the "
+                         "tensor itself")
+    used = h + SCALE_BYTES * n_blocks(h, f)
+    return -(-used // LANE) * LANE
+
+
+def wire_row_bytes(h: int, fmt: WireFormatLike, dtype) -> int:
+    """Bytes one logical row occupies ON THE WIRE — the quantity the
+    perf_model's bytes-by-precision rooflines and the trace byte
+    attribution price. Native: the tensor's own bytes."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        return h * jnp.dtype(dtype).itemsize
+    return wire_cols(h, f)
+
+
+def quantize(x: jax.Array, fmt: WireFormatLike):
+    """Block-scaled quantization along the last axis ->
+    (payload (..., H) in the format's dtype, scale (..., nb) f32).
+
+    The per-row (block=None) fp8 path is op-for-op the legacy ep_a2a
+    `_quantize_fp8` formula — absmax/FP8_MAX, floored at SCALE_EPS —
+    so the migrated EP dispatch ships bitwise-identical payloads
+    (pinned by the dedupe test)."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        raise ValueError("native wire is not quantized")
+    h = x.shape[-1]
+    nb = n_blocks(h, f)
+    xf = x.astype(jnp.float32)
+    if nb == 1:
+        amax = jnp.max(jnp.abs(xf), axis=-1)
+    else:
+        xb = xf.reshape(x.shape[:-1] + (nb, f.block))
+        amax = jnp.max(jnp.abs(xb), axis=-1)          # (..., nb)
+    s = jnp.maximum(amax / _fmax(f), SCALE_EPS)
+    if nb == 1:
+        scaled = xf / s[..., None]
+    else:
+        scaled = (xb / s[..., None]).reshape(x.shape)
+    if f.kind == "fp8":
+        q = scaled.astype(jnp.float8_e4m3fn)
+    else:
+        q = jnp.clip(jnp.round(scaled), -INT8_MAX, INT8_MAX).astype(
+            jnp.int8)
+    if nb == 1:
+        s = s[..., None]
+    return q, s
+
+
+def dequantize(q: jax.Array, scale: jax.Array, fmt: WireFormatLike,
+               out_dtype):
+    """(payload, scale) -> (..., H) in out_dtype; f32 multiply (the
+    consume-edge accumulation dtype), cast last — the legacy ep_a2a
+    decode order."""
+    f = resolve(fmt)
+    h = q.shape[-1]
+    nb = scale.shape[-1]
+    qf = q.astype(jnp.float32)
+    if nb == 1:
+        out = qf * scale
+    else:
+        blk = h // nb
+        out = (qf.reshape(q.shape[:-1] + (nb, blk))
+               * scale[..., None]).reshape(q.shape)
+    return out.astype(out_dtype)
+
+
+def encode_rows(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
+    """(rows, H) float -> (rows, wire_cols) int8 wire image: payload
+    bytes, then the f32 scales bitcast into byte columns, then zero
+    lane padding. Pure jnp — usable on host arrays and on VMEM values
+    inside Pallas kernel bodies (the send edge)."""
+    f = resolve(fmt)
+    q, s = quantize(x, f)
+    m, h = x.shape
+    if f.kind == "fp8":
+        qb = jax.lax.bitcast_convert_type(q, jnp.int8)
+    else:
+        qb = q
+    sb = jax.lax.bitcast_convert_type(s, jnp.int8).reshape(m, -1)
+    pad = wire_cols(h, f) - h - sb.shape[1]
+    return jnp.concatenate(
+        [qb, sb, jnp.zeros((m, pad), jnp.int8)], axis=-1)
+
+
+def decode_rows(w: jax.Array, h: int, fmt: WireFormatLike,
+                out_dtype) -> jax.Array:
+    """(rows, wire_cols) int8 wire image -> (rows, h) in out_dtype (the
+    consume edge; f32 math inside, see dequantize)."""
+    f = resolve(fmt)
+    nb = n_blocks(h, f)
+    m = w.shape[0]
+    qb = w[:, :h]
+    if f.kind == "fp8":
+        q = jax.lax.bitcast_convert_type(qb, jnp.float8_e4m3fn)
+    else:
+        q = qb
+    s = jax.lax.bitcast_convert_type(
+        w[:, h:h + SCALE_BYTES * nb].reshape(m, nb, SCALE_BYTES),
+        jnp.float32)
+    return dequantize(q, s, f, out_dtype)
+
+
+def pack(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
+    """Host-level send edge: per-device array (rows, ...) -> wire image
+    (rows, wire_cols) int8, trailing dims flattened. Native format
+    passes the array through untouched (zero cost when off)."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        return x
+    if x.ndim < 2:
+        raise ValueError(
+            f"quantized wire needs >=2D per-device arrays, got {x.shape}")
+    return encode_rows(x.reshape(x.shape[0], -1), f)
+
+
+def unpack(w: jax.Array, trailing_shape, fmt: WireFormatLike,
+           out_dtype) -> jax.Array:
+    """Host-level consume edge: wire image (rows, wire_cols) ->
+    (rows,) + trailing_shape in out_dtype. Native: pass-through."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        return w
+    h = math.prod(trailing_shape)
+    out = decode_rows(w, h, f, out_dtype)
+    return out.reshape((w.shape[0],) + tuple(trailing_shape))
+
+
+def roundtrip(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
+    """encode+decode in place — the wire-fidelity reference every
+    quantized collective is tested against (transport moves wire bytes,
+    never changes them, so kernel output == roundtrip-composed
+    reference). Native: identity."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        return x
+    return unpack(pack(x, f), x.shape[1:], f, x.dtype)

@@ -1,0 +1,186 @@
+"""Numerics harness: ulp/cosine drift of quantized-wire collectives.
+
+A wire format is a precision/bandwidth trade, and the trade is only
+honest when the precision side is MEASURED, not asserted. This module
+measures it: for each (collective, format) pair it replays the exact
+fold order the kernel executes — per-hop decode-add-(re)encode for the
+reduction rings, one encode per shard for the gather family — in pure
+jnp on stacked per-rank data (no mesh needed), and reports the drift of
+the quantized result against the same fold over the f32/native wire:
+
+  cosine_drift  1 - cosine similarity (f64), the EQuARX-style model-
+                quality proxy; what `DEFAULT_ERROR_BUDGET` bounds.
+  max_ulp_f32   worst-element ulp distance in f32 bit space — the
+                bitwise face of the same comparison: 0 iff the results
+                are bit-identical as f32 (the native-wire case, pinned
+                by tests/test_wire.py).
+
+The simulations are also the oracles the mesh tests compare the real
+kernels against (transport moves wire bytes, never changes them, so a
+kernel whose output differs from its simulation has a transport bug,
+not a codec choice). `perf_model.estimate_wire_drift`'s constants are
+calibrated on this harness — see the calibration note there.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.wire import codec
+
+# Default cosine-drift budget for choose_wire_format callers that give
+# none: admits fp8/int8 at the harness-measured drift of every shipped
+# collective at n <= 8 (worst measured: fp8 two-shot AR ~1.5e-3 at
+# n=8, H=512 normal data — per-hop requantization on the RS leg is the
+# dominant term; see tests/test_wire.py), with ~3x headroom for seed
+# variation — and tight enough that a genuinely lossy configuration
+# (longer requant chains, distribution-mismatched data) trips it.
+DEFAULT_ERROR_BUDGET = 5e-3
+
+COLLECTIVES = (
+    "allgather",
+    "low_latency_allgather",
+    "reduce_scatter",
+    "allreduce",
+    "allgather_gemm",
+    "gemm_reduce_scatter",
+)
+
+
+def cosine_drift(a, b) -> float:
+    """1 - cosine similarity of the flattened f64 views (0 = parallel).
+    Degenerate zero vectors count as no drift only when both are."""
+    af = np.asarray(a, np.float64).ravel()
+    bf = np.asarray(b, np.float64).ravel()
+    na, nb = float(np.linalg.norm(af)), float(np.linalg.norm(bf))
+    if na == 0.0 or nb == 0.0:
+        return 0.0 if na == nb else 1.0
+    return float(1.0 - np.dot(af, bf) / (na * nb))
+
+
+def max_ulp_f32(a, b) -> int:
+    """Worst-element ulp distance between a and b viewed as f32 (0 iff
+    bit-identical as f32; sign-aware via the usual monotone int map)."""
+    ai = np.asarray(a, np.float32).ravel().view(np.int32).astype(np.int64)
+    bi = np.asarray(b, np.float32).ravel().view(np.int32).astype(np.int64)
+    ai = np.where(ai < 0, np.int64(-(2**31)) - ai - 1, ai)
+    bi = np.where(bi < 0, np.int64(-(2**31)) - bi - 1, bi)
+    return int(np.max(np.abs(ai - bi))) if ai.size else 0
+
+
+def _drift(q, f) -> Dict[str, float]:
+    return {"cos": cosine_drift(q, f), "ulp": max_ulp_f32(q, f)}
+
+
+def codec_drift(fmt, shape=(64, 512), dtype=jnp.bfloat16,
+                seed=0) -> Dict[str, float]:
+    """Drift of one encode/decode roundtrip vs the tensor itself."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(shape), dtype)
+    return _drift(codec.roundtrip(x, fmt).astype(jnp.float32),
+                  x.astype(jnp.float32))
+
+
+def _chunk(x, c, n):
+    m = x.shape[0] // n
+    return x[c * m:(c + 1) * m].astype(jnp.float32)
+
+
+def simulate_ring_rs(stacked, fmt, n: int):
+    """The ring-RS fold in the kernels' exact order (chunk c: loaded by
+    rank c+1, then decode-add-(re)encode at c+2, ..., c; the final
+    arrival is decode+add WITHOUT re-encode). stacked: (n, n*m, K) per-
+    rank contributions. Returns the (n, m, K) f32 result, chunk c at
+    index c. Native format degrades to the plain f32 fold."""
+    f = codec.resolve(fmt)
+    out = []
+    for c in range(n):
+        order = [(c + 1 + j) % n for j in range(n)]
+        val = _chunk(stacked[order[0]], c, n)
+        for j, r in enumerate(order[1:]):
+            if f.kind != "native":
+                val = codec.decode_rows(
+                    codec.encode_rows(val, f), val.shape[-1], f,
+                    jnp.float32)
+            val = val + _chunk(stacked[r], c, n)
+        out.append(val)
+    return jnp.stack(out)
+
+
+def simulate_allreduce(stacked, fmt, n: int):
+    """Two-shot AR = the RS fold (returned in the INPUT dtype, exactly
+    as ring_reduce_scatter hands its chunk to the AG leg) + one
+    gather-leg roundtrip of each reduced chunk (the AG wire image is
+    encoded once and forwarded as bytes — no per-hop requantization on
+    the gather leg). Result in the input dtype, chunk-major."""
+    f = codec.resolve(fmt)
+    rs = simulate_ring_rs(stacked, f, n).astype(stacked.dtype)
+    if f.kind == "native":
+        return rs.reshape(-1, rs.shape[-1])
+    return jnp.stack([
+        codec.roundtrip(rs[c], f) for c in range(n)
+    ]).reshape(-1, rs.shape[-1])
+
+
+def collective_drift(collective: str, fmt, n: int = 8, shape=(64, 512),
+                     dtype=jnp.bfloat16, seed=0) -> Dict[str, float]:
+    """Drift of one (collective, format) pair vs its f32/native-wire
+    fold, replaying the kernel's fold order on stacked per-rank data.
+    `shape` is the per-rank (rows, K); rows must divide by n for the
+    reduction family."""
+    rng = np.random.default_rng(seed)
+    f = codec.resolve(fmt)
+    if collective in ("allgather", "low_latency_allgather"):
+        x = jnp.asarray(rng.standard_normal(shape), dtype)
+        return _drift(codec.roundtrip(x, f).astype(jnp.float32),
+                      x.astype(jnp.float32))
+    if collective == "allgather_gemm":
+        k = shape[1]
+        a = jnp.asarray(rng.standard_normal(shape) * 0.1, dtype)
+        b = jnp.asarray(rng.standard_normal((k, 128)) * 0.1, dtype)
+        q = jnp.dot(codec.roundtrip(a, f).astype(jnp.float32), b.astype(
+            jnp.float32))
+        r = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+        return _drift(q, r)
+    if collective in ("reduce_scatter", "allreduce",
+                      "gemm_reduce_scatter"):
+        if shape[0] % n:
+            raise ValueError(f"rows {shape[0]} must divide by n={n}")
+        stacked = jnp.asarray(
+            rng.standard_normal((n,) + tuple(shape)), dtype)
+        sim = (simulate_allreduce if collective == "allreduce"
+               else simulate_ring_rs)
+        return _drift(sim(stacked, f, n), sim(stacked, "native", n))
+    raise ValueError(f"unknown collective {collective!r} "
+                     f"(one of {COLLECTIVES})")
+
+
+def drift_table(n: int = 8, shape=(64, 512), dtype=jnp.bfloat16,
+                formats=("fp8", "int8"), seed=0):
+    """{(collective, format kind): drift dict} over the full shipped
+    grid — the accuracy column beside the bench's speedup columns."""
+    out = {}
+    for coll in COLLECTIVES:
+        for fmt in formats:
+            out[(coll, codec.resolve(fmt).kind)] = collective_drift(
+                coll, fmt, n=n, shape=shape, dtype=dtype, seed=seed)
+    return out
+
+
+def drift_monotone_in_block(fmt_kind: str = "fp8", h: int = 512,
+                            blocks=(32, 128, None), rows: int = 64,
+                            seed: int = 0):
+    """Cosine drift per scale-block size, smallest block first. Smaller
+    blocks put every scale closer to its data, so drift is monotone
+    non-decreasing in block size (None = whole row, the coarsest) —
+    the property tests pin."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((rows, h)), jnp.bfloat16)
+    return [
+        codec_drift(codec.WireFormat(fmt_kind, b), shape=(rows, h),
+                    seed=seed)["cos"]
+        for b in blocks
+    ]
