@@ -45,6 +45,12 @@ arm under --trace-dir (default ./traces), and measures the tracing
 overhead on the ag_gemm kernel arm — `overhead_frac` (traced/untraced
 chain time - 1) is HARD-ASSERTED < 0.03 so instrumentation can never
 silently tax the kernels it observes.
+
+`--faults` (opt-in; see docs/robustness.md): the same gate for the
+guard plane — `faults_overhead_frac` (guarded/plain ag_gemm chain
+time - 1) HARD-ASSERTED < 0.03, plus `faults_guard_trips` (the clean
+chain's watchdog-trip audit, asserted 0: a guard that trips without a
+fault is as broken as one that never trips).
 """
 
 import json
@@ -994,6 +1000,90 @@ def bench_serving(mesh, qps_levels=(1.0, 4.0), n_requests=10,
 
 
 TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
+FAULTS_OVERHEAD_CEIL = 0.03  # hard guard on --faults watchdog cost
+
+
+def _ag_overhead_chain(mesh, cfg, strip_trailing, out_cols=None):
+    """The ag_gemm fori chain both instrumentation-overhead gates time
+    (--trace and --faults): identical program modulo which build context
+    is active outside. `strip_trailing` keeps only the primary result
+    when the active build appends a trailing buffer (trace or guard).
+    ONE definition so the two gates can never silently measure
+    different programs."""
+    cols = out_cols or HIDDEN
+
+    def bld(k):
+        def per_rank(x, w1):
+            m_loc = x.shape[0]
+
+            def body(_, c):
+                res = ag_gemm(c, w1, axis="tp", config=cfg,
+                              force_kernel=True, c_order="arrival")
+                h = res[0] if strip_trailing else res
+                h = jax.lax.optimization_barrier(h)
+                return h[:m_loc, :cols].astype(c.dtype)
+
+            out = jax.lax.fori_loop(0, k, body, x)
+            return jnp.sum(out.astype(jnp.float32)).reshape(1)
+
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=mesh,
+                in_specs=(P("tp"), P(None, "tp")),
+                out_specs=P("tp"), check_vma=False,
+            )
+        )
+
+    return bld
+
+
+def bench_faults_overhead(mesh, x, w1, k_hi=41, pairs=7,
+                          out_cols=None, ceil=None):
+    """Watchdog overhead on the forced ag_gemm kernel arm (the --trace
+    gate mirrored for the guard plane): the identical chain timed with
+    and without an active faults.guard build. Returns
+    (overhead_frac, guarded_ms, plain_ms, n_trips); overhead_frac is
+    hard-asserted < FAULTS_OVERHEAD_CEIL and the clean chain must
+    record ZERO guard trips — a guard that costs real latency or trips
+    without a fault must not ship silently. (Zero-cost when OFF is the
+    separate bit-identity contract tests/test_faults.py pins.)"""
+    from triton_dist_tpu import faults
+
+    cfg = AgGemmConfig(256, 3200, 512)
+    chain = lambda guarded: _ag_overhead_chain(  # noqa: E731
+        mesh, cfg, strip_trailing=guarded, out_cols=out_cols)
+
+    ms, _ = _chain_timer(chain(False), (x, w1), k_hi=k_hi, pairs=pairs)
+    with faults.building():
+        g_ms, _ = _chain_timer(chain(True), (x, w1), k_hi=k_hi,
+                               pairs=pairs)
+        # one non-chained guarded run for the trip audit (the chain
+        # drops the guard buffers inside fori_loop on purpose)
+        fn = jax.jit(jax.shard_map(
+            lambda x, w: ag_gemm(x, w, axis="tp", config=cfg,
+                                 force_kernel=True, c_order="arrival"),
+            mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=(P(None, "tp"), P("tp")),
+            check_vma=False))
+        _c, g = jax.block_until_ready(fn(x, w1))
+    import numpy as _np
+
+    world = mesh.devices.size
+    trips = faults.decode(_np.asarray(g).reshape(
+        world, -1, faults.GUARD_WORDS))
+    assert not trips, (
+        f"guarded ag_gemm tripped {len(trips)} watchdog(s) with no "
+        f"fault injected: {trips[:3]}")
+    frac = g_ms / ms - 1.0
+    # `ceil` is overridable ONLY so the tiny-shape test smoke (whose
+    # sub-ms chains are all timer noise) can exercise the arm; the
+    # driver path always runs the production ceiling
+    ceil = FAULTS_OVERHEAD_CEIL if ceil is None else ceil
+    assert frac < ceil, (
+        f"guard overhead {frac:.4f} exceeds the "
+        f"{ceil} ceiling on the ag_gemm arm "
+        f"({g_ms:.4f} vs {ms:.4f} ms)")
+    return frac, g_ms, ms, len(trips)
 
 
 def bench_trace_overhead(mesh, x, w1, k_hi=41, pairs=7):
@@ -1005,35 +1095,12 @@ def bench_trace_overhead(mesh, x, w1, k_hi=41, pairs=7):
     from triton_dist_tpu import trace
 
     cfg = AgGemmConfig(256, 3200, 512)
+    chain = lambda traced: _ag_overhead_chain(  # noqa: E731
+        mesh, cfg, strip_trailing=traced)
 
-    def build(traced):
-        def bld(k):
-            def per_rank(x, w1):
-                m_loc = x.shape[0]
-
-                def body(_, c):
-                    res = ag_gemm(c, w1, axis="tp", config=cfg,
-                                  force_kernel=True, c_order="arrival")
-                    h = res[0] if traced else res
-                    h = jax.lax.optimization_barrier(h)
-                    return h[:m_loc, :HIDDEN].astype(c.dtype)
-
-                out = jax.lax.fori_loop(0, k, body, x)
-                return jnp.sum(out.astype(jnp.float32)).reshape(1)
-
-            return jax.jit(
-                jax.shard_map(
-                    per_rank, mesh=mesh,
-                    in_specs=(P("tp"), P(None, "tp")),
-                    out_specs=P("tp"), check_vma=False,
-                )
-            )
-
-        return bld
-
-    ms, _ = _chain_timer(build(False), (x, w1), k_hi=k_hi, pairs=pairs)
+    ms, _ = _chain_timer(chain(False), (x, w1), k_hi=k_hi, pairs=pairs)
     with trace.building(cap=512):
-        tr_ms, _ = _chain_timer(build(True), (x, w1), k_hi=k_hi,
+        tr_ms, _ = _chain_timer(chain(True), (x, w1), k_hi=k_hi,
                                 pairs=pairs)
     frac = tr_ms / ms - 1.0
     assert frac < TRACE_OVERHEAD_CEIL, (
@@ -1106,7 +1173,7 @@ _STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
 # signed numerics: legitimately negative (an overhead measurement can
 # read slightly below zero in chain-timer noise) — exempt from the
 # `v < 0` malformed-value rule, never from finiteness
-_SIGNED_KEYS = {"overhead_frac"}
+_SIGNED_KEYS = {"overhead_frac", "faults_overhead_frac"}
 _NUMERIC_KEYS = {
     "value", "vs_baseline",
     "mega_8b_hbm_floor_ms", "mega_8b_gap_vs_floor",
@@ -1146,7 +1213,15 @@ _NUMERIC_KEYS = {
     "allreduce_wire_int8_us", "allreduce_wire_fp8_vs_native",
     "allreduce_wire_int8_vs_native",
     "ag_gemm_wire_fp8_ms", "ag_gemm_wire_fp8_vs_native",
+    # guarded execution (ISSUE 10): watchdog overhead on the ag_gemm
+    # arm (--faults; mirror of the --trace overhead gate) + the clean
+    # chain's trip audit (must be 0 — a guard that trips without a
+    # fault is broken)
+    "faults_overhead_frac", "faults_guard_trips",
 }
+# the --faults keys travel together (an overhead claim without its trip
+# audit — or vice versa — is unfalsifiable from the artifact)
+_FAULTS_KEYS = {"faults_overhead_frac", "faults_guard_trips"}
 # the SP-prefill keys travel together: a round that emits any of them
 # must emit them all plus the tail-stat raw dict — a ratio without its
 # absolute arms (or vice versa) is unfalsifiable from the artifact
@@ -1245,6 +1320,16 @@ def check_result(result: dict) -> list:
                 "allreduce_wire_model_pick must ride beside the "
                 "allreduce_wire_* keys (the selector's choice is part "
                 "of the artifact)")
+    flt_present = _FAULTS_KEYS & set(result)
+    if flt_present:
+        for k in _FAULTS_KEYS - set(result):
+            problems.append(
+                f"faults keys travel together: {k!r} missing while "
+                f"{sorted(flt_present)[0]!r} is present")
+        if result.get("faults_guard_trips", 0) != 0:
+            problems.append(
+                "faults_guard_trips must be 0 on the clean bench chain "
+                "(a guard tripping without a fault is broken)")
     agw_present = _AG_WIRE_KEYS & set(result)
     if agw_present:
         for k in _AG_WIRE_KEYS - set(result):
@@ -1432,6 +1517,25 @@ def main():
         result.update(bench_serving(mesh))
     except Exception as e:
         result["serve_error"] = str(e)[:200]
+
+    if "--faults" in sys.argv:
+        # opt-in guarded-execution smoke arm (never on the driver's
+        # default path): the watchdog-overhead gate on the ag_gemm
+        # kernel chain, mirror of the --trace gate below. The asserts
+        # are HARD failures by design — guards that tax the kernels
+        # > 3% when on, or trip without a fault, must not ship.
+        rng = np.random.default_rng(0)
+        xf = jnp.asarray(
+            rng.standard_normal((M, HIDDEN)) * 0.02, jnp.bfloat16)
+        w1f = jnp.asarray(
+            rng.standard_normal((HIDDEN, N_GATE_UP * world)) * 0.02,
+            jnp.bfloat16)
+        ffrac, g_ms, un_ms, ntrips = bench_faults_overhead(mesh, xf, w1f)
+        result["faults_overhead_frac"] = round(ffrac, 4)
+        result["faults_guard_trips"] = ntrips
+        print(f"bench.py --faults: faults_overhead_frac={ffrac:.4f} "
+              f"({g_ms:.4f} vs {un_ms:.4f} ms), trips={ntrips}",
+              file=sys.stderr)
 
     if "--trace" in sys.argv:
         # opt-in observability pass (never on the driver's default path):

@@ -34,6 +34,19 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+# The HB analyses are pure python, but the DYNAMIC guard-polarity
+# mutants (tests/_mutants.py guard_reset_poll) run a real 2-device
+# interpret-mode cell — bootstrap a virtual CPU mesh BEFORE anything
+# imports jax. No-op when the parent process (tests, __graft_entry__)
+# already initialized jax with enough devices.
+if "jax" not in sys.modules:
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 from triton_dist_tpu.verify import registry  # noqa: E402
 
 
@@ -79,6 +92,25 @@ def check_shipped(names=None, verbose=False) -> int:
     return 1 if bad else 0
 
 
+def check_liveness_cli(names=None, verbose=False) -> int:
+    """Liveness under symbolic fault models (verify/liveness.py): every
+    dropped signal / dropped delivery on every shipped protocol must
+    map to a detected deadlock or race — a SILENT fault cell fails."""
+    from triton_dist_tpu.verify import liveness
+
+    try:
+        problems = liveness.check_liveness(names or None)
+    except KeyError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for p in problems:
+        print(f"  [liveness] {p}")
+    n = len(registry.load_shipped() if not names else names)
+    print(f"verify_kernels --liveness: {n} protocol(s), "
+          f"{len(problems)} silent fault cell(s)")
+    return 1 if problems else 0
+
+
 def check_mutants(verbose=False) -> int:
     muts = _load_mutants()
     if not muts:
@@ -110,6 +142,9 @@ def main(argv=None) -> int:
                     help="protocol names to check (default: all)")
     ap.add_argument("--mutants", action="store_true",
                     help="check the seeded-bad corpus is 100%% flagged")
+    ap.add_argument("--liveness", action="store_true",
+                    help="check every dropped signal/delivery maps to "
+                         "a detected deadlock or race (never silent)")
     ap.add_argument("--list", action="store_true",
                     help="list registered protocols and exit")
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -122,6 +157,9 @@ def main(argv=None) -> int:
         return 0
     if args.mutants:
         return check_mutants(verbose=args.verbose)
+    if args.liveness:
+        return check_liveness_cli(args.names or None,
+                                  verbose=args.verbose)
     return check_shipped(args.names or None, verbose=args.verbose)
 
 

@@ -144,6 +144,20 @@ def _wire_scale_no_gate(n):
         _v.read(so.at(j))   # dequant reads race in-flight scale writes
 
 
+@_v.mutant("guard_reset_poll", expect="guard-no-trip", ns=(2,),
+           doc="watchdog whose poll budget resets on every re-read: it "
+               "never reaches its deadline, so a REAL lost signal "
+               "degrades back to the silent wrong answer guards exist "
+               "to kill. DYNAMIC mutant: the chaos harness runs the "
+               "LL-AG dropped-barrier cell under the seeded watchdog "
+               "and must observe the missing trip (needs a 2-device "
+               "CPU mesh — scripts/verify_kernels.py bootstraps one)")
+def _guard_reset_poll(n):
+    from triton_dist_tpu.faults import chaos
+
+    return chaos.watchdog_mutant_findings(n, impl="reset_poll")
+
+
 @_v.mutant("rs_ring_no_credit", expect=_v.RACE,
            doc="RS ring with the credit flow control removed: symmetric "
                "acc-slot reuse without discharge — a fast upstream "

@@ -525,3 +525,95 @@ def test_mega_paged_decode_runs_over_pool_export(eng8):
     lg_ref, _ = mega.decode_step(tok, pc_ref)
     np.testing.assert_array_equal(np.asarray(lg_pool),
                                   np.asarray(lg_ref))
+
+
+# ---------- failure paths (ISSUE 10 satellites) ----------
+# The happy paths above pin bit-identity; these pin the UNHAPPY ones:
+# QueueFull backpressure under a burst arrival trace, cancel while a
+# request is mid-prefill, and eviction-then-requeue ordering while an
+# injected stalled step exercises the retry ladder concurrently.
+
+
+def test_queue_full_backpressure_under_burst(eng1, prompts):
+    """A burst beyond max_pending must 429 (QueueFull) — and draining
+    the queue must restore admission, with every admitted request still
+    bit-identical to its sequential run."""
+    q = RequestQueue(max_pending=2)
+    sch = Scheduler(eng1, queue=q, **GEO)
+    admitted = [sch.submit(prompts[0], max_new_tokens=3),
+                sch.submit(prompts[1], max_new_tokens=3)]
+    with pytest.raises(QueueFull):
+        sch.submit(prompts[2], max_new_tokens=3)
+    # the rejection left no span residue and no scheduler state
+    assert len(sch.requests) == 2
+    sch.run()
+    late = sch.submit(prompts[2], max_new_tokens=3)  # drained: admitted
+    sch.run()
+    toks = [r.out_tokens for r in admitted + [late]]
+    assert toks == _sequential(eng1, prompts, 3)
+
+
+def test_cancel_during_prefill_frees_slot(eng1, prompts):
+    """Cancel a request whose prompt is mid-prefill (pos > 0, chunk
+    boundary not reached): the slot and pages free on the next step and
+    the other request is unaffected bit-for-bit."""
+    sch = Scheduler(eng1, **GEO)
+    victim = sch.submit(prompts[0], max_new_tokens=3)   # 12 tokens > chunk
+    keeper = sch.submit(prompts[1], max_new_tokens=3)
+    sch.step()  # one chunk of prefill each
+    assert victim.state is RequestState.PREFILL and victim.pos > 0
+    used_before = sch.pool.used_pages()
+    sch.cancel(victim)
+    sch.run()
+    assert victim.state is RequestState.CANCELLED
+    assert victim.out_tokens == []
+    assert sch.pool.used_pages() < used_before
+    sch.pool.check()
+    assert keeper.out_tokens == _sequential(eng1, [prompts[1]], 3)[0]
+
+
+def test_evict_requeue_ordering_under_stalled_step(eng1, prompts):
+    """Page pressure forces an eviction; the evicted request requeues
+    with its ORIGINAL arrival seq (ahead of later same-priority
+    arrivals) while an injected stalled step exercises the retry ladder
+    mid-flight — and every completion stays bit-identical."""
+    from triton_dist_tpu import faults
+
+    total = eng1.max_len  # 64 tokens / page 8 = 8 pages shared
+    sch = Scheduler(eng1, slots=2, chunk=GEO["chunk"], page=GEO["page"],
+                    total_pages=5, max_step_retries=2,
+                    retry_backoff_s=0.0005)
+    # A (12 + 14 = 26 tokens -> 4 pages) outgrows the 5-page pool while
+    # B (10 + 14 = 24 -> 3 pages) holds pages; A is the OLDER admission,
+    # so when its 4th page comes due the strictly-younger B is evicted
+    first = sch.submit(prompts[0], max_new_tokens=14)
+    second = sch.submit(prompts[1], max_new_tokens=14)
+    plan = faults.FaultPlan(faults.FailStep(at_step=3, times=1))
+    order = []
+    orig_admit = sch._admit
+
+    def probe_admit():
+        before = set(id(r) for r in sch.active.values())
+        orig_admit()
+        for r in sch.active.values():
+            if id(r) not in before:
+                order.append(r)
+
+    sch._admit = probe_admit
+    with faults.injecting(plan):
+        # grow both until one must evict the other
+        for _ in range(200):
+            if not sch.step() and sch.queue.peek() is None:
+                break
+    assert second.n_evictions >= 1, (
+        "page pressure must have evicted the younger request")
+    assert first.n_evictions == 0  # a strict total order: no thrash
+    assert sch.metrics()["step_retries"] >= 1  # the stall really fired
+    assert sch.metrics()["quarantined"] == 0   # transient: no quarantine
+    # the evicted request re-admitted (original seq kept it at the
+    # front of its priority class)
+    assert order.count(second) >= 2
+    toks = [first.out_tokens, second.out_tokens]
+    assert toks == _sequential(eng1, prompts[:2], 14)
+    sch.pool.check()
+    del total

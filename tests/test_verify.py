@@ -301,7 +301,9 @@ def test_every_mutant_flagged_with_expected_class():
 
     muts = registry.mutants()
     assert len(muts) >= 4
-    expected = {"deadlock", "data-race", "sem-leak"}
+    # guard-no-trip is the DYNAMIC class (the chaos harness runs the
+    # seeded watchdog on a real mesh — ISSUE 10's guard-polarity corpus)
+    expected = {"deadlock", "data-race", "sem-leak", "guard-no-trip"}
     seen_classes = set()
     for name, spec in sorted(muts.items()):
         fs = registry.verify_spec(spec)

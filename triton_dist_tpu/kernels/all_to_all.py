@@ -30,6 +30,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu import verify as _v
+from triton_dist_tpu.faults import guard as _guard
+from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
@@ -154,7 +156,8 @@ def all_to_all_ref(x: jax.Array, splits: jax.Array, axis: str = EP_AXIS):
 # -- chunked transport (the EP MoE pipeline's arrival-granular A2A) ----------
 
 
-def _a2a_chunked_kernel(axis, n, q, rows, straggler, build, *refs):
+def _a2a_chunked_kernel(axis, n, q, rows, straggler, build, gbuild,
+                        *refs):
     """Chunk-granular A2A: segment payloads travel as `q` row-chunks, and
     chunk (step i, c) lands on its OWN delivery semaphore slot
     recv_sems[i, c] — the TPU analog of the reference's per-peer
@@ -173,84 +176,83 @@ def _a2a_chunked_kernel(axis, n, q, rows, straggler, build, *refs):
     instant every rank emits (payload = this rank's injected delay, 0
     off-rank — uniform record sequences keep cross-rank seq aligned for
     the delivery replay, trace/attribution.a2a_step_waits)."""
-    if build is not None:
-        (x_ref, s_ref, o_ref, os_ref, tbuf, cp_sem, send_sem, recv_sems,
-         meta_send_sem, meta_recv_sem, tcur) = refs
-    else:
-        (x_ref, s_ref, o_ref, os_ref, cp_sem, send_sem, recv_sems,
-         meta_send_sem, meta_recv_sem) = refs
-        tbuf = tcur = None
+    refs = list(refs)
+    x_ref, s_ref, o_ref, os_ref = refs[:4]
+    del refs[:4]
+    tbuf = refs.pop(0) if build is not None else None
+    gbuf = refs.pop(0) if gbuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
+    tcur = refs.pop() if build is not None else None
+    (cp_sem, send_sem, recv_sems, meta_send_sem, meta_recv_sem) = refs
     me = jax.lax.axis_index(axis)
     tctx = trace_ev.make_ctx(build, tbuf, tcur)
     trace_ev.init_ctx(tctx, rank=me)
     R = trace_ev.REGIONS
-    shmem.barrier_all(axis)
-    if straggler is not None:
-        # race provocation: stall one rank between entering the kernel
-        # and issuing its sends, so its peers' per-chunk waits really
-        # wait (pattern of the megakernel AR skew stress)
-        trace_ev.instant(
-            tctx, R["straggle"],
-            payload=jnp.where(me == straggler[0], straggler[1], 0))
-        shmem.straggler_delay(axis, straggler[0], straggler[1])
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, tctx=tctx)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx):
+        shmem.barrier_all(axis)
+        if straggler is not None:
+            # race provocation: stall one rank between entering the
+            # kernel and issuing its sends, so its peers' per-chunk
+            # waits really wait (pattern of the megakernel AR skew
+            # stress)
+            trace_ev.instant(
+                tctx, R["straggle"],
+                payload=jnp.where(me == straggler[0], straggler[1], 0))
+            shmem.straggler_delay(axis, straggler[0], straggler[1])
 
-    # Local segment: chunk-granular local copies, each on its own slot
-    # (recv_sems row 0 — ring step 0 is "self", so the slot space is
-    # uniform: slot [i, c] == chunk c from source offset i). A shared
-    # local semaphore would let chunk c's wait be satisfied by chunk
-    # c+1's completion (waits are byte-counted, not tagged), silently
-    # voiding the chunk-major arrival guarantee.
-    local = []
-    for c in range(q):
-        sl = pl.ds(c * rows, rows)
-        cp = pltpu.make_async_copy(x_ref.at[me, sl], o_ref.at[me, sl],
-                                   recv_sems.at[0, c])
-        cp.start()
-        local.append(cp)
-    cps = pltpu.make_async_copy(s_ref.at[me], os_ref.at[me], cp_sem)
-
-    handles = {}
-    meta_handles = []
-    for i in range(1, n):
-        peer = jnp.mod(me + i, n)
+        # Local segment: chunk-granular local copies, each on its own
+        # slot (recv_sems row 0 — ring step 0 is "self", so the slot
+        # space is uniform: slot [i, c] == chunk c from source offset
+        # i). A shared local semaphore would let chunk c's wait be
+        # satisfied by chunk c+1's completion (waits are byte-counted,
+        # not tagged), silently voiding the chunk-major arrival
+        # guarantee.
+        local = []
         for c in range(q):
             sl = pl.ds(c * rows, rows)
-            rdma = pltpu.make_async_remote_copy(
-                src_ref=x_ref.at[peer, sl],
-                dst_ref=o_ref.at[me, sl],
-                send_sem=send_sem,
-                recv_sem=recv_sems.at[i, c],
-                device_id={axis: peer},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-            trace_ev.instant(tctx, R["a2a.send"], payload=i, aux=c)
-            rdma.start()
-            handles[(i, c)] = rdma
-        meta = pltpu.make_async_remote_copy(
-            src_ref=s_ref.at[peer],
-            dst_ref=os_ref.at[me],
-            send_sem=meta_send_sem,
-            recv_sem=meta_recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        meta.start()
-        meta_handles.append(meta)
+            cp = pltpu.make_async_copy(x_ref.at[me, sl],
+                                       o_ref.at[me, sl],
+                                       recv_sems.at[0, c])
+            cp.start()
+            local.append(cp)
+        cps = pltpu.make_async_copy(s_ref.at[me], os_ref.at[me], cp_sem)
 
-    # Chunk-major consumption: after iteration c the output rows of chunk
-    # c are complete FROM EVERY SOURCE while chunks c+1.. are still in
-    # flight — the wait order a fused consumer interleaves compute into.
-    for c in range(q):
-        with trace_ev.span(tctx, R["a2a.local"], payload=c):
-            local[c].wait()
+        handles = {}
+        meta_handles = []
         for i in range(1, n):
-            with trace_ev.span(tctx, R["a2a.wait"], payload=i, aux=c):
-                handles[(i, c)].wait()
-    cps.start()
-    cps.wait()
-    for i, h in enumerate(meta_handles):
-        with trace_ev.span(tctx, R["a2a.meta"], payload=i + 1):
-            h.wait()
+            peer = jnp.mod(me + i, n)
+            for c in range(q):
+                sl = pl.ds(c * rows, rows)
+                trace_ev.instant(tctx, R["a2a.send"], payload=i, aux=c)
+                handles[(i, c)] = shmem.putmem_nbi(
+                    o_ref.at[me, sl], x_ref.at[peer, sl], send_sem,
+                    recv_sems.at[i, c], peer, axis,
+                )
+            meta_handles.append(shmem.putmem_nbi(
+                os_ref.at[me], s_ref.at[peer], meta_send_sem,
+                meta_recv_sem, peer, axis,
+            ))
+
+        # Chunk-major consumption: after iteration c the output rows of
+        # chunk c are complete FROM EVERY SOURCE while chunks c+1.. are
+        # still in flight — the wait order a fused consumer interleaves
+        # compute into.
+        for c in range(q):
+            shmem.guard_progress(c)
+            with trace_ev.span(tctx, R["a2a.local"], payload=c):
+                local[c].wait()
+            for i in range(1, n):
+                with trace_ev.span(tctx, R["a2a.wait"], payload=i,
+                                   aux=c):
+                    handles[(i, c)].wait_send()
+                    handles[(i, c)].wait_recv(slot=i)
+        cps.start()
+        cps.wait()
+        for i, h in enumerate(meta_handles):
+            with trace_ev.span(tctx, R["a2a.meta"], payload=i + 1):
+                h.wait()
 
 
 def all_to_all_chunked(
@@ -270,9 +272,11 @@ def all_to_all_chunked(
     x: (n, C, hidden) with C % n_chunks == 0; splits: (n,) or (n, S).
     straggler: optional (rank, nanos) skew injection for stress tests.
 
-    Tracing (trace.building active): returns a THIRD output — the
-    per-rank device trace buffer — on every path (fallbacks hand back an
-    empty buffer), so callers' output trees are build-stable.
+    Tracing (trace.building active): returns an extra trailing output —
+    the per-rank device trace buffer — on every path (fallbacks hand
+    back an empty buffer), so callers' output trees are build-stable.
+    Guarding (faults.guard.building active): one more trailing output,
+    the guard buffer (after the trace buffer when both are active).
     """
     n = jax.lax.axis_size(axis)
     if x.shape[0] != n:
@@ -284,14 +288,18 @@ def all_to_all_chunked(
             f"{x.shape[1]}"
         )
     build = trace_ev.active_build()
+    gbuild = _guard.active_build()
+    straggler = _fplan.scheduled_straggler("all_to_all_chunked",
+                                           straggler)
 
-    def with_trace(res, tbuf=None):
-        return trace_ev.with_trace(build, res, tbuf)
+    def with_both(res, tbuf=None, gbuf=None):
+        return _guard.with_guard(
+            gbuild, trace_ev.with_trace(build, res, tbuf), gbuf)
 
     if n == 1:
-        return with_trace((x, splits.astype(jnp.int32)))
+        return with_both((x, splits.astype(jnp.int32)))
     if interpret_no_headroom():
-        return with_trace(all_to_all_ref(x, splits, axis))
+        return with_both(all_to_all_ref(x, splits, axis))
     rows = x.shape[1] // q
     splits2d = splits.reshape(n, -1).astype(jnp.int32)
     out_shape = (
@@ -313,9 +321,13 @@ def all_to_all_chunked(
         out_shape += (trace_ev.out_shape(build),)
         out_specs += (trace_ev.out_spec(),)
         scratch.append(trace_ev.cursor_scratch())
+    if gbuild is not None:
+        out_shape += (_guard.out_shape(gbuild),)
+        out_specs += (_guard.out_spec(),)
+        scratch.append(_guard.cursor_scratch())
     res = tpu_call(
         functools.partial(_a2a_chunked_kernel, axis, n, q, rows,
-                          straggler, build),
+                          straggler, build, gbuild),
         out_shape=out_shape,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -329,8 +341,11 @@ def all_to_all_chunked(
         ),
     )(x, splits2d)
     out, out_splits = res[:2]
-    return with_trace((out, out_splits.reshape(splits.shape)),
-                      res[2] if build is not None else None)
+    k = 2
+    tbuf = res[k] if build is not None else None
+    k += 1 if build is not None else 0
+    gbuf = res[k] if gbuild is not None else None
+    return with_both((out, out_splits.reshape(splits.shape)), tbuf, gbuf)
 
 
 # -- protocol models (static verifier, triton_dist_tpu.verify) ---------------

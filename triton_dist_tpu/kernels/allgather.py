@@ -38,6 +38,7 @@ from triton_dist_tpu.lang.core import (
     next_collective_id,
     interpret_no_headroom,
 )
+from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.wire import codec as wcodec
 
@@ -66,7 +67,7 @@ def choose_allgather_method(nbytes_per_rank: int) -> AllGatherMethod:
     return AllGatherMethod.Ring1D
 
 
-def _ring_ag_kernel(axis: str, n: int, x_ref, o_ref, local_sem, send_sem, recv_sem):
+def _ring_ag_kernel(axis: str, n: int, gbuild, *refs):
     """1-D ring AG: step s sends chunk (me-s) mod n to the right neighbor
     (ref: allgather.py:140-194 ring push; same chunk rotation).
 
@@ -77,38 +78,63 @@ def _ring_ag_kernel(axis: str, n: int, x_ref, o_ref, local_sem, send_sem, recv_s
     (the analog of the reference's per-chunk barrier words,
     allgather.py:106-138). Output slots are distinct per chunk, so no
     flow control is needed on the data buffers themselves."""
+    x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem = \
+        _ag_unpack(gbuild, refs)
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0]
-    shmem.neighbor_barrier(axis, me, n)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx):
+        shmem.neighbor_barrier(axis, me, n)
+        shmem.fault_delay(axis, "allgather")
 
-    # Publish the local shard into our own slot.
-    cp = pltpu.make_async_copy(x_ref, o_ref.at[pl.ds(me * m, m)], local_sem)
-    cp.start()
-    cp.wait()
+        # Publish the local shard into our own slot.
+        cp = pltpu.make_async_copy(x_ref, o_ref.at[pl.ds(me * m, m)],
+                                   local_sem)
+        cp.start()
+        cp.wait()
 
-    right = jnp.mod(me + 1, n)
-    for s in range(n - 1):
-        slot = jnp.mod(me - s, n)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=o_ref.at[pl.ds(slot * m, m)],
-            dst_ref=o_ref.at[pl.ds(slot * m, m)],
-            send_sem=send_sem,
-            recv_sem=recv_sem.at[s],
-            device_id={axis: right},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        # Wait for our send AND for the incoming chunk (me-s-1) mod n —
-        # next step's send source; program order is the dependency chain.
-        rdma.wait()
+        right = jnp.mod(me + 1, n)
+        for s in range(n - 1):
+            slot = jnp.mod(me - s, n)
+            shmem.guard_progress(s)
+            h = shmem.putmem_nbi(
+                o_ref.at[pl.ds(slot * m, m)],
+                o_ref.at[pl.ds(slot * m, m)],
+                send_sem, recv_sem.at[s], right, axis,
+            )
+            # Wait for our send AND for the incoming chunk (me-s-1)
+            # mod n — next step's send source; program order is the
+            # dependency chain.
+            h.wait_send()
+            h.wait_recv(slot=s)
 
 
-def _full_mesh_ag_kernel(axis: str, n: int, x_ref, o_ref, local_sem, send_sem, recv_sem):
+def _full_mesh_ag_kernel(axis: str, n: int, gbuild, *refs):
     """Full-mesh push AG: put the local shard directly into every peer's
     slot `me` (ref: allgather.py:81-138 cp_engine full-mesh push). The
     body is the device-side `fcollect` primitive."""
-    shmem.barrier_all(axis)
-    shmem.fcollect(o_ref, x_ref, local_sem, send_sem, recv_sem, axis, n)
+    x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem = \
+        _ag_unpack(gbuild, refs)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    _guard.init_ctx(gctx, rank=jax.lax.axis_index(axis))
+    with _guard.attached(gctx):
+        shmem.barrier_all(axis)
+        shmem.fault_delay(axis, "allgather")
+        shmem.fcollect(o_ref, x_ref, local_sem, send_sem, recv_sem,
+                       axis, n)
+
+
+def _ag_unpack(gbuild, refs):
+    """Outputs (o_ref + guard buffer) precede scratch; the guard cursor
+    is the trailing scratch entry."""
+    refs = list(refs)
+    x_ref, o_ref = refs[0], refs[1]
+    del refs[:2]
+    gbuf = refs.pop(0) if gbuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
+    local_sem, send_sem, recv_sem = refs
+    return x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem
 
 
 def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
@@ -116,22 +142,29 @@ def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
     n = jax.lax.axis_size(axis)
     if x.ndim < 2:
         raise ValueError(f"all_gather needs >=2D shards, got shape {x.shape}")
+    gbuild = _guard.active_build()
     out_shape = jax.ShapeDtypeStruct((n * x.shape[0],) + x.shape[1:], x.dtype)
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
     recv = (
         pltpu.SemaphoreType.DMA((max(n - 1, 1),))
         if per_step_recv
         else pltpu.SemaphoreType.DMA
     )
+    scratch = [
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+        recv,
+    ]
+    if gbuild is not None:
+        out_shape = (out_shape, _guard.out_shape(gbuild))
+        out_specs = (out_specs, _guard.out_spec())
+        scratch.append(_guard.cursor_scratch())
     return tpu_call(
-        functools.partial(kernel_body, axis, n),
+        functools.partial(kernel_body, axis, n, gbuild),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            recv,
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True, collective_id=next_collective_id(name)
         ),
@@ -151,14 +184,18 @@ def _wire_ag(x: jax.Array, axis: str, fmt, transport,
     wire fidelity (kernel output is BITWISE the pack/unpack roundtrip
     composition, which the tests pin)."""
     n = jax.lax.axis_size(axis)
+    gbuild = _guard.active_build()
     w = wcodec.pack(x, fmt)
+    gbuf = None
     if n == 1 and not force_kernel:
         gathered = w
     elif interpret_no_headroom():
         gathered = jax.lax.all_gather(w, axis, tiled=True)
     else:
-        gathered = transport(w)
-    return wcodec.unpack(gathered, x.shape[1:], fmt, x.dtype)
+        res = transport(w)
+        gathered, gbuf = (res if gbuild is not None else (res, None))
+    return _guard.with_guard(
+        gbuild, wcodec.unpack(gathered, x.shape[1:], fmt, x.dtype), gbuf)
 
 
 def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
@@ -172,6 +209,7 @@ def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
     n == 1 early return (bench.py wire arms measure the world=1 edge
     cost)."""
     fmt = wcodec.resolve(wire_format)
+    gbuild = _guard.active_build()
     if not wcodec.is_native(fmt):
         return _wire_ag(
             x, axis, fmt,
@@ -179,9 +217,10 @@ def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
                                  f"ring_ag_{axis}", per_step_recv=True),
             force_kernel)
     if jax.lax.axis_size(axis) == 1 and not force_kernel:
-        return x
+        return _guard.with_guard(gbuild, x)
     if interpret_no_headroom():
-        return jax.lax.all_gather(x, axis, tiled=True)
+        return _guard.with_guard(
+            gbuild, jax.lax.all_gather(x, axis, tiled=True))
     return _pallas_ag(x, axis, _ring_ag_kernel, f"ring_ag_{axis}",
                       per_step_recv=True)
 
@@ -193,6 +232,7 @@ def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS,
     a single shared recv semaphore is exact here. wire_format as in
     ring_all_gather (the push moves the wire image)."""
     fmt = wcodec.resolve(wire_format)
+    gbuild = _guard.active_build()
     if not wcodec.is_native(fmt):
         return _wire_ag(
             x, axis, fmt,
@@ -200,9 +240,10 @@ def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS,
                                  f"fm_ag_{axis}", per_step_recv=False),
             force_kernel=False)
     if jax.lax.axis_size(axis) == 1:
-        return x
+        return _guard.with_guard(gbuild, x)
     if interpret_no_headroom():
-        return jax.lax.all_gather(x, axis, tiled=True)
+        return _guard.with_guard(
+            gbuild, jax.lax.all_gather(x, axis, tiled=True))
     return _pallas_ag(x, axis, _full_mesh_ag_kernel, f"fm_ag_{axis}",
                       per_step_recv=False)
 
@@ -252,9 +293,11 @@ def all_gather(
                 x.shape[1:], wire_format, x.dtype)
         return jax.lax.all_gather(x, axis, tiled=True)
     if method == AllGatherMethod.Ring1D:
-        return ring_all_gather(x, axis, wire_format=wire_format)
+        return _guard.primary(
+            ring_all_gather(x, axis, wire_format=wire_format))
     if method == AllGatherMethod.FullMesh:
-        return full_mesh_all_gather(x, axis, wire_format=wire_format)
+        return _guard.primary(
+            full_mesh_all_gather(x, axis, wire_format=wire_format))
     raise ValueError(f"unknown method {method}")
 
 

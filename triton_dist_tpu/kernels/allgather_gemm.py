@@ -59,6 +59,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.faults import guard as _guard
+from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
@@ -112,7 +114,8 @@ def _silu_mul_f32(g, u):
 def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
                     need_ws: bool, cache_a: bool, silu_pair: bool,
-                    arrival: bool, grouped: bool, wire, build, *refs):
+                    arrival: bool, grouped: bool, wire, build, gbuild,
+                    *refs):
     # `wire`: None for the native payload, else (fmt, k) — the A shard /
     # ring workspace hold the block-scaled int8 wire image (payload
     # columns [0, k), per-row f32 scales bitcast at [k, k+4)); the ring
@@ -126,6 +129,8 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     ws_ref, c_ref = refs[:2]
     del refs[:2]
     tbuf = refs.pop(0) if build is not None else None
+    gbuf = refs.pop(0) if gbuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
     a_buf = refs.pop(0)
     scale_buf = refs.pop(0) if wire is not None else None
     # nk==1 (full-K tiles) stores the dot straight to the output block:
@@ -153,6 +158,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     j = pl.program_id(2)
     kk = pl.program_id(3)
     me = jax.lax.axis_index(axis)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, tctx=tctx)
     m_loc = a_ref.shape[0]
     chunk = jnp.mod(me - s, n)
     right = jnp.mod(me + 1, n)
@@ -263,6 +269,14 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 tctx, R["straggle"],
                 payload=jnp.where(me == straggler[0], straggler[1], 0))
 
+    if gctx is not None:
+        # guard init likewise rides the first grid step (grid order
+        # guarantees it precedes every ring wait); gated on gctx so the
+        # unguarded build traces byte-identically
+        @pl.when(jnp.logical_and(flat == 0, s == 0))
+        def _guard_init():
+            _guard.init_ctx(gctx, rank=me)
+
     # --- producer side: runs once per ring step, before that step's tiles.
     if need_ws:
         @pl.when(jnp.logical_and(flat == 0, s == 0))
@@ -300,7 +314,21 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 prev.wait_send()
                 # consumer wait: this step's A rows have landed
                 # (the dl.wait/consume_token contract, ref :236-237).
-                prev.wait_recv()
+                if gctx is None:
+                    prev.wait_recv()
+                else:
+                    # bounded ring-step watchdog: readiness is the full
+                    # chunk's element count (interpreter discharge) or
+                    # byte count (hardware DMA semaphore)
+                    from triton_dist_tpu.lang.core import use_interpret
+
+                    _guard.set_progress(s, ctx=gctx)
+                    elems = m_loc * ws_ref.shape[1]
+                    amount = elems if use_interpret() else \
+                        elems * jnp.dtype(ws_ref.dtype).itemsize
+                    _guard.watchdog_wait(
+                        prev.wait_recv, recv_sems.at[s - 1], amount,
+                        "ring", slot=s, ctx=gctx)
 
             @pl.when(s < n - 1)
             def _():
@@ -460,9 +488,14 @@ def ag_gemm(
     """
     cfg = config or AgGemmConfig()
     build = trace_ev.active_build()
+    gbuild = _guard.active_build()
 
     def with_trace(res, tbuf=None):
         return trace_ev.with_trace(build, res, tbuf)
+
+    def with_fallback(res):
+        # fallback paths owe both trailing buffers (empty streams)
+        return _guard.with_guard(gbuild, with_trace(res))
     out_dtype = out_dtype or a_shard.dtype
     silu_pair = epilogue == "silu_pair"
     assert epilogue in (None, "silu_pair"), f"unknown epilogue {epilogue}"
@@ -560,7 +593,7 @@ def ag_gemm(
         # (and XLA fuses the silu_pair epilogue into the dot's output for
         # free — measured 0.73 vs 0.80 ms for the two-accumulator Pallas
         # variant at the bench shape, benchmark/sweep_ag_gemm.py).
-        return with_trace(xla_path())
+        return with_fallback(xla_path())
 
     fit = fit_tile  # shared tile-fitting rule (lang.core)
 
@@ -601,7 +634,7 @@ def ag_gemm(
         not force_kernel
     ):
         # Fallback: XLA AG + dot (the reference's torch path analog).
-        return with_trace(xla_path())
+        return with_fallback(xla_path())
 
     need_ws = n > 1 or return_gathered
     grid = (n, mt, nt, nk)
@@ -665,13 +698,18 @@ def ag_gemm(
         out_shape += (trace_ev.out_shape(build),)
         out_specs += (trace_ev.out_spec(),)
         scratch.append(trace_ev.cursor_scratch())
+    if gbuild is not None:
+        out_shape += (_guard.out_shape(gbuild),)
+        out_specs += (_guard.out_spec(),)
+        scratch.append(_guard.cursor_scratch())
+    straggler = _fplan.scheduled_straggler("allgather_gemm") \
+        or (cfg.straggler_rank, cfg.straggler_ns)
     res = tpu_call(
         functools.partial(_ag_gemm_kernel, axis, n, mt, nt, nk,
-                          tm, tn, tk, out_dtype,
-                          (cfg.straggler_rank, cfg.straggler_ns),
+                          tm, tn, tk, out_dtype, straggler,
                           need_ws, cache_a, silu_pair, arrival, grouped,
                           (fmt, k, a_shard.dtype) if wire else None,
-                          build),
+                          build, gbuild),
         grid=grid,
         out_shape=out_shape,
         in_specs=in_specs,
@@ -706,8 +744,13 @@ def ag_gemm(
     ws, c = res[:2]
     if wire and return_gathered:
         ws = wcodec.unpack(ws, (k,), fmt, a_shard.dtype)
-    tbuf = res[2] if build is not None else None
-    return with_trace((c, ws) if return_gathered else c, tbuf)
+    k_res = 2
+    tbuf = res[k_res] if build is not None else None
+    k_res += 1 if build is not None else 0
+    gbuf = res[k_res] if gbuild is not None else None
+    return _guard.with_guard(
+        gbuild, with_trace((c, ws) if return_gathered else c, tbuf),
+        gbuf)
 
 
 def ag_gemm_ref(a_shard: jax.Array, b: jax.Array, axis: str = TP_AXIS):

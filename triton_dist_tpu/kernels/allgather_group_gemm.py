@@ -44,7 +44,9 @@ def moe_all_gather(x_shard: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     n = jax.lax.axis_size(axis)
     if n == 1 or interpret_no_headroom():
         return jax.lax.all_gather(x_shard, axis, tiled=True)
-    return ring_all_gather(x_shard, axis)
+    from triton_dist_tpu.faults import guard as _guard
+
+    return _guard.primary(ring_all_gather(x_shard, axis))
 
 
 def ag_group_gemm(

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
@@ -149,11 +150,22 @@ def two_shot_all_reduce(x: jax.Array, axis: str = TP_AXIS,
     Measured: [perf:allreduce_wire_fp8_vs_native=0.15-5.0] (the wide
     round-gated band — world=1 reads the codec edge tax, world>=2 the
     ICI-bound wire win; see docs/performance.md "Quantized wire").
-    force_kernel: run the ring kernels even at world=1 (bench arms)."""
-    scattered = ring_reduce_scatter(x, axis, wire_format=wire_format,
-                                    force_kernel=force_kernel)
-    return ring_all_gather(scattered, axis, wire_format=wire_format,
-                           force_kernel=force_kernel)
+    force_kernel: run the ring kernels even at world=1 (bench arms).
+
+    Guarding (faults.guard.building active): one extra trailing output,
+    the stacked (2, 1+cap, GUARD_WORDS) guard buffers of the RS and AG
+    legs (both legs' watchdog trips are attributable separately)."""
+    gbuild = _guard.active_build()
+    if gbuild is None:
+        scattered = ring_reduce_scatter(x, axis, wire_format=wire_format,
+                                        force_kernel=force_kernel)
+        return ring_all_gather(scattered, axis, wire_format=wire_format,
+                               force_kernel=force_kernel)
+    scattered, g_rs = ring_reduce_scatter(
+        x, axis, wire_format=wire_format, force_kernel=force_kernel)
+    out, g_ag = ring_all_gather(scattered, axis, wire_format=wire_format,
+                                force_kernel=force_kernel)
+    return out, jnp.stack([g_rs, g_ag])
 
 
 def all_reduce(
@@ -173,12 +185,24 @@ def all_reduce(
     method (one-shot pushes full tensors whose local sum wants the
     native payload; XLA psum cannot express the codec)."""
     if not isinstance(axis, str):
+        gbuild = _guard.active_build()
         out = x
+        gbufs = []
         for ax in tuple(axis):
-            out = all_reduce(out, ax, method=method,
+            res = all_reduce(out, ax, method=method,
                              wire_format=wire_format,
                              error_budget=error_budget)
-        return out
+            if gbuild is None:
+                out = res
+            else:
+                # keep every stage's guard buffer — stripping them
+                # would mute a tripped watchdog into a silently wrong
+                # result (the failure class this plane exists to kill)
+                out, g = res
+                gbufs.append(g if g.ndim == 3 else g[None])
+        if gbuild is None:
+            return out
+        return out, jnp.concatenate(gbufs, axis=0)
 
     n = jax.lax.axis_size(axis)
     nbytes = x.size * x.dtype.itemsize
@@ -212,10 +236,15 @@ def all_reduce(
         else:
             method = choose_allreduce_method(nbytes, n)
     if method == AllReduceMethod.XLA:
-        return jax.lax.psum(x, axis)
+        return _guard.with_guard(_guard.active_build(),
+                                 jax.lax.psum(x, axis))
     if method == AllReduceMethod.OneShot:
-        return one_shot_all_reduce(x, axis)
+        return _guard.with_guard(_guard.active_build(),
+                                 one_shot_all_reduce(x, axis))
     return two_shot_all_reduce(x, axis)
+
+
+PROTOCOL_NAME = "allreduce"  # degradation-registry key
 
 
 def all_reduce_op(
@@ -224,32 +253,84 @@ def all_reduce_op(
     axis: str = TP_AXIS,
     method: AllReduceMethod = AllReduceMethod.Auto,
     wire_format=None,
+    fallback=None,
 ) -> jax.Array:
     """Host-level AR. `arr` stacks per-rank contributions: (n, ...), sharded
     on dim 0; returns the replicated sum over ranks
     (ref host entry: allreduce.py:1129-1208 chunked all_reduce).
     wire_format as in all_reduce (quantized = two-shot wire legs;
-    "auto" defers to choose_wire_format inside the jitted program)."""
+    "auto" defers to choose_wire_format inside the jitted program).
+
+    fallback="xla" is the guard-tripped degradation route
+    (docs/robustness.md): under an active guard build, a watchdog trip
+    inside the ring kernels marks the protocol degraded and this call —
+    and every later one — returns lax.psum's result instead of raising,
+    so a degraded step completes rather than dies. Without fallback, a
+    trip raises DeadlineExceeded with the decoded guard rows."""
     n = int(mesh.shape[axis])
     if arr.shape[0] != n:
         raise ValueError(
             f"all_reduce_op expects one stacked contribution per rank: "
             f"leading dim {arr.shape[0]} != axis size {n}"
         )
+    if fallback not in (None, "xla"):
+        raise ValueError(f"unknown fallback {fallback!r} (None or 'xla')")
+    if fallback == "xla" and _guard.is_degraded(PROTOCOL_NAME):
+        return _ar_xla_jit(mesh, axis)(arr)
     fmt = "auto" if wire_format == "auto" else wcodec.resolve(wire_format)
-    return _ar_op_jit(mesh, axis, method, fmt)(arr)
+    gbuild = _guard.active_build()
+    res = _ar_op_jit(mesh, axis, method, fmt, gbuild)(arr)
+    if gbuild is None:
+        return res
+    out, gout = res
+    import numpy as np
+
+    g = np.asarray(gout)
+    trips = _guard.decode(g)
+    if trips:
+        if fallback == "xla":
+            _guard.degrade(PROTOCOL_NAME)
+            return _ar_xla_jit(mesh, axis)(arr)
+        _guard.check(g, context=PROTOCOL_NAME)
+    return out
 
 
 @functools.lru_cache(maxsize=None)
-def _ar_op_jit(mesh, axis: str, method: AllReduceMethod, fmt):
+def _ar_op_jit(mesh, axis: str, method: AllReduceMethod, fmt,
+               gbuild=None):
     from jax.sharding import PartitionSpec as P
 
     def fn(xs):
-        return all_reduce(xs[0], axis, method=method, wire_format=fmt)
+        import contextlib
+
+        with _guard.building(gbuild.cap, gbuild.deadline) if gbuild \
+                else contextlib.nullcontext():
+            res = all_reduce(xs[0], axis, method=method, wire_format=fmt)
+        if gbuild is None:
+            return res
+        out, g = res
+        # normalize to (legs, 1+cap, WORDS) so the gathered global is
+        # decode-ready regardless of which method path traced
+        if g.ndim == 2:
+            g = g[None]
+        return out, g
+
+    out_specs = P() if gbuild is None else (P(), P(axis))
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=out_specs,
+                      check_vma=False)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _ar_xla_jit(mesh, axis: str):
+    """The degraded route: lax.psum (XLA owns the reduction trees) —
+    no Pallas protocol to hang."""
+    from jax.sharding import PartitionSpec as P
 
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=P(),
-                      check_vma=False)
+        jax.shard_map(lambda xs: jax.lax.psum(xs[0], axis), mesh=mesh,
+                      in_specs=P(axis), out_specs=P(), check_vma=False)
     )
 
 

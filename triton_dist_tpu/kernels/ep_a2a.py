@@ -417,8 +417,20 @@ def _a2a_select(transport, n_chunks, straggler):
     the chunked kernel's device buffer, or an empty stream for the
     untraced arms — so the pipeline's output tree is build-stable."""
     if transport == "chunked":
-        return lambda x, s, axis: all_to_all_chunked(
-            x, s, axis, n_chunks=n_chunks, straggler=straggler)
+        from triton_dist_tpu.faults import guard as _guard
+
+        # the EP pipeline does not thread guard buffers through its
+        # output tree (trace buffers are), so the transport traces
+        # UNGUARDED under an active build: a guarded kernel whose trip
+        # rows were discarded would mute a detected fault into a
+        # silently wrong MoE output — worse than the unguarded
+        # behavior. guard.suppressed keeps the zero-cost-off program.
+        def chunked(x, s, axis):
+            with _guard.suppressed():
+                return all_to_all_chunked(
+                    x, s, axis, n_chunks=n_chunks, straggler=straggler)
+
+        return chunked
     if transport == "plain":
         base = all_to_all  # falls back to the ref itself under
         # interpret_no_headroom — no second copy of that predicate here

@@ -62,6 +62,8 @@ from triton_dist_tpu.kernels.flash_decode import _fd_chunk as _kv_block
 from triton_dist_tpu.kernels.low_latency_allgather import (
     segment_collect_start,
 )
+from triton_dist_tpu.faults import guard as _guard
+from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     cdiv,
@@ -356,23 +358,28 @@ def flash_prefill_local(
 
 
 def _fp_sp_kernel(axis, n, bsz, s, hq, hkv, d, blk, causal, scale,
-                  straggler, build, *refs):
-    if build is not None:
-        (len_ref, q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, tbuf,
-         vkv, sems, send_sem, seg_sems, tcur) = refs
-    else:
-        (len_ref, q_ref, k_ref, v_ref, o_ref, kbuf, vbuf,
-         vkv, sems, send_sem, seg_sems) = refs
-        tbuf = tcur = None
+                  straggler, build, gbuild, *refs):
+    refs = list(refs)
+    len_ref, q_ref, k_ref, v_ref, o_ref, kbuf, vbuf = refs[:7]
+    del refs[:7]
+    tbuf = refs.pop(0) if build is not None else None
+    gbuf = refs.pop(0) if gbuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
+    tcur = refs.pop() if build is not None else None
+    vkv, sems, send_sem, seg_sems = refs
     me = jax.lax.axis_index(axis)
     g = hq // hkv
     nblk = s // blk
     tctx = trace_ev.make_ctx(build, tbuf, tcur)
     trace_ev.init_ctx(tctx, rank=me)
     R = trace_ev.REGIONS
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, tctx=tctx)
+    _guard.init_ctx(gctx, rank=me)
 
     # peers must be inside the kernel before the segment puts land
-    shmem.barrier_all(axis)
+    with _guard.attached(gctx):
+        shmem.barrier_all(axis)
+        shmem.fault_delay(axis, "flash_prefill")
     if straggler is not None:
         trace_ev.instant(
             tctx, R["straggle"],
@@ -435,10 +442,15 @@ def _fp_sp_kernel(axis, n, bsz, s, hq, hkv, d, blk, causal, scale,
     for i in range(1, n):
         # gate on exactly THIS segment's delivery (K then V — same slot
         # pair every rank's descriptor names for offset i), while
-        # segments i+1.. are still in flight
+        # segments i+1.. are still in flight. Under a guard build each
+        # gate is a bounded watchdog wait at site "recv" (slot = the
+        # segment offset) — a dropped delivery becomes a guard row,
+        # never a hang.
+        _guard.set_progress(i, ctx=gctx)
         with trace_ev.span(tctx, R["fp.wait"], payload=i):
-            for h in handles[i]:
-                h.wait_recv()
+            with _guard.attached(gctx):
+                for h in handles[i]:
+                    h.wait_recv(slot=i)
         chunk = jax.lax.rem(me - i + n, n)
         with trace_ev.span(tctx, R["fp.fold"], payload=i):
             fold_segment(chunk * s,
@@ -479,6 +491,8 @@ def sp_flash_prefill(
     w = hkv * d
     scale = float(scale if scale is not None else d ** -0.5)
     build = trace_ev.active_build()
+    gbuild = _guard.active_build()
+    straggler = _fplan.scheduled_straggler("flash_prefill", straggler)
     # segments cannot pad (padding would shift global KV positions), so
     # a requested block is re-fitted to the divisor rule (fit_block) —
     # the same rule the autotuner's pruner models and flash_prefill_ref
@@ -490,12 +504,14 @@ def sp_flash_prefill(
     if n == 1:
         out = flash_prefill_local(q, k, v, kv_len=kv_len, causal=causal,
                                   scale=scale, block=blk)
-        return trace_ev.with_trace(build, out)
+        return _guard.with_guard(gbuild, trace_ev.with_trace(build, out))
     if interpret_no_headroom():
         from triton_dist_tpu.kernels.sp_attention import ring_attention
 
-        return trace_ev.with_trace(build, ring_attention(
-            q, k, v, axis, causal=causal, scale=scale, kv_len=kv_len))
+        return _guard.with_guard(gbuild, trace_ev.with_trace(
+            build, ring_attention(
+                q, k, v, axis, causal=causal, scale=scale,
+                kv_len=kv_len)))
     len_arr = (jnp.full((b,), n * s, jnp.int32) if kv_len is None
                else jnp.reshape(kv_len, (-1,)).astype(jnp.int32))
     itemsize = jnp.dtype(k.dtype).itemsize
@@ -521,9 +537,13 @@ def sp_flash_prefill(
         out_shape += (trace_ev.out_shape(build),)
         out_specs += (trace_ev.out_spec(),)
         scratch.append(trace_ev.cursor_scratch())
+    if gbuild is not None:
+        out_shape += (_guard.out_shape(gbuild),)
+        out_specs += (_guard.out_spec(),)
+        scratch.append(_guard.cursor_scratch())
     res = tpu_call(
         functools.partial(_fp_sp_kernel, axis, n, b, s, hq, hkv, d, blk,
-                          causal, scale, straggler, build),
+                          causal, scale, straggler, build, gbuild),
         out_shape=out_shape,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -546,8 +566,12 @@ def sp_flash_prefill(
         ),
     )(len_arr, q.reshape(b, s, hq * d), k2, v2)
     out = res[0].reshape(b, s, hq, d)
-    return trace_ev.with_trace(build, out,
-                               res[3] if build is not None else None)
+    k_res = 3
+    tbuf = res[k_res] if build is not None else None
+    k_res += 1 if build is not None else 0
+    gbuf = res[k_res] if gbuild is not None else None
+    return _guard.with_guard(
+        gbuild, trace_ev.with_trace(build, out, tbuf), gbuf)
 
 
 def flash_prefill_ref(
@@ -651,7 +675,9 @@ def sp_prefill_attention(
     if impl == "ring":
         out = ring_attention(q, k, v, axis, causal=causal, scale=scale,
                              kv_len=kv_len)
-        return trace_ev.with_trace(trace_ev.active_build(), out)
+        return _guard.with_guard(
+            _guard.active_build(),
+            trace_ev.with_trace(trace_ev.active_build(), out))
     raise ValueError(f"unknown sp prefill impl {impl!r}")
 
 

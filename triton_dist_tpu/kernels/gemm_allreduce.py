@@ -56,7 +56,9 @@ def gemm_ar(
     # primary(): build-safe under trace.building() (buffers dropped; see
     # tp_mlp.dist_fwd)
     scattered = primary(gemm_rs(a, b, axis, config=config))
-    return ring_all_gather(scattered, axis)
+    from triton_dist_tpu.faults import guard as _guard
+
+    return _guard.primary(ring_all_gather(scattered, axis))
 
 
 def gemm_ar_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:

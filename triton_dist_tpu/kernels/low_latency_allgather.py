@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.verify import capture as _vcap
 from triton_dist_tpu.lang.core import (
@@ -67,22 +68,33 @@ def create_ll_ag_buffer(x_shape, dtype, n: int,
     return jnp.zeros((2, n) + tuple(x_shape), dtype)
 
 
-def _ll_ag_kernel(axis: str, n: int, flags_ref, x_ref, buf_in, buf_out,
-                  send_sem, recv_sems, local_sem):
+def _ll_ag_kernel(axis: str, n: int, gbuild, flags_ref, x_ref, buf_in,
+                  buf_out, *refs):
+    if gbuild is not None:
+        # outputs precede scratch: gbuf is output 1, gcur the last scratch
+        gbuf, send_sem, recv_sems, local_sem, gcur = refs
+    else:
+        send_sem, recv_sems, local_sem = refs
+        gbuf = gcur = None
     parity = flags_ref[0]
     first = flags_ref[1]
     del buf_in  # aliased: access through buf_out
 
-    @pl.when(first == 1)
-    def _():
-        # fresh context: peers must be inside the kernel before the first
-        # puts land (afterwards the parity protocol orders everything)
-        shmem.barrier_all(axis)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    _guard.init_ctx(gctx, rank=shmem.my_pe(axis))
+    with _guard.attached(gctx):
+        @pl.when(first == 1)
+        def _():
+            # fresh context: peers must be inside the kernel before the
+            # first puts land (afterwards the parity protocol orders
+            # everything)
+            shmem.barrier_all(axis)
 
-    shmem.fcollect_slots(
-        lambda pe: buf_out.at[parity, pe], x_ref,
-        local_sem, send_sem, recv_sems.at[parity], axis, n,
-    )
+        shmem.fault_delay(axis, "low_latency_allgather")
+        shmem.fcollect_slots(
+            lambda pe: buf_out.at[parity, pe], x_ref,
+            local_sem, send_sem, recv_sems.at[parity], axis, n,
+        )
 
 
 def ll_all_gather(
@@ -106,10 +118,15 @@ def ll_all_gather(
     through the SAME parity protocol (the context must have been created
     with the same format — create_ll_ag_buffer(wire_format=...)); every
     slot including the rank's own passes the codec, so the gathered
-    result is the pack/unpack roundtrip of the shards."""
+    result is the pack/unpack roundtrip of the shards.
+
+    Guarding (faults.guard.building active): one extra trailing output —
+    the kernel's guard buffer (bounded-watchdog trip rows; empty stream
+    on the fallback paths) — which the caller feeds to guard.check."""
     n = jax.lax.axis_size(axis)
     fmt = wcodec.resolve(wire_format)
     wire = not wcodec.is_native(fmt)
+    gbuild = _guard.active_build()
 
     def decode(slots):
         # (n, rows, kw) wire slots -> (n,) + x.shape in x.dtype
@@ -120,10 +137,12 @@ def ll_all_gather(
             (n,) + x.shape)
 
     if n == 1:
-        return (wcodec.roundtrip(x, fmt)[None] if wire else x[None]), buf
+        out = wcodec.roundtrip(x, fmt)[None] if wire else x[None]
+        return _guard.with_guard(gbuild, (out, buf))
     xw = wcodec.pack(x, fmt)
     if interpret_no_headroom():
-        return decode(jax.lax.all_gather(xw, axis)), buf
+        return _guard.with_guard(
+            gbuild, (decode(jax.lax.all_gather(xw, axis)), buf))
 
     call_count = jnp.asarray(call_count, jnp.int32)
     if first is None:
@@ -132,57 +151,123 @@ def ll_all_gather(
         jnp.asarray(call_count % 2, jnp.int32),
         jnp.asarray(first, jnp.int32),
     ])
-    out, buf = _ll_ag_call(flags, xw, buf, call_count % 2, axis, n)
-    return decode(out), buf
+    res = _ll_ag_call(flags, xw, buf, call_count % 2, axis, n, gbuild)
+    out, buf = res[:2]
+    gbuf = res[2] if gbuild is not None else None
+    if gbuild is not None and wire and fmt.checksum:
+        # detect-and-record consume edge: a corrupted slot becomes a
+        # wire guard row the host raises on (WireIntegrityError via
+        # guard.check) instead of dequantizing garbage silently
+        import math as _math
+
+        flat = out.reshape(n * out.shape[1], out.shape[2])
+        ok = jnp.all(wcodec.verify_rows(
+            flat, _math.prod(x.shape[1:]), fmt))
+        gbuf = _guard.stream_trip(gbuf, ok)
+    return _guard.with_guard(gbuild, (decode(out), buf), gbuf)
 
 
-def _ll_ag_call(flags, x, buf, parity, axis, n):
-    kernel = functools.partial(_ll_ag_kernel, axis, n)
-    buf = tpu_call(
+def _ll_ag_call(flags, x, buf, parity, axis, n, gbuild=None):
+    kernel = functools.partial(_ll_ag_kernel, axis, n, gbuild)
+    out_shape = jax.ShapeDtypeStruct(buf.shape, buf.dtype)
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+    scratch = [
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA,
+    ]
+    if gbuild is not None:
+        out_shape = (out_shape, _guard.out_shape(gbuild))
+        # explicit block shape: PrefetchScalarGridSpec does not accept
+        # the shapeless SMEM spec the gridless kernels use
+        out_specs = (out_specs, pl.BlockSpec(
+            (1 + gbuild.cap, _guard.GUARD_WORDS),
+            lambda i, *_: (0, 0),  # *_: the scalar-prefetch operand
+            memory_space=pltpu.SMEM))
+        scratch.append(_guard.cursor_scratch())
+    res = tpu_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[
-                pltpu.SemaphoreType.DMA,
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA,
-            ],
+            out_specs=out_specs,
+            scratch_shapes=scratch,
         ),
-        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        out_shape=out_shape,
         input_output_aliases={2: 0},
         compiler_params=compiler_params(
             has_side_effects=True,
             collective_id=next_collective_id(f"ll_ag_{axis}"),
         ),
     )(flags, x, buf)
-    return jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False), buf
+    buf, gbuf = (res if gbuild is not None else (res, None))
+    out = jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False)
+    return (out, buf) + ((gbuf,) if gbuild is not None else ())
 
 
 @functools.lru_cache(maxsize=None)
-def _ll_op_fn(mesh, axis: str, fmt=None):
-    """Cached jitted executable per (mesh, axis, wire format):
-    call_count and the fresh-context flag ride as traced arguments, so
-    every decode step replays one compiled program (a fresh closure per
-    call would retrace — the opposite of low-latency)."""
+def _ll_op_fn(mesh, axis: str, fmt=None, gbuild=None):
+    """Cached jitted executable per (mesh, axis, wire format, guard
+    build): call_count and the fresh-context flag ride as traced
+    arguments, so every decode step replays one compiled program (a
+    fresh closure per call would retrace — the opposite of
+    low-latency). An active guard build is part of the cache key — its
+    executable has a different output tree (the trailing guard buffer)
+    and must never be served to unguarded callers (or vice versa)."""
     from jax.sharding import PartitionSpec as P
 
     def per_device(x_shard, buf_shard, cc, first):
-        out, new_buf = ll_all_gather(x_shard, buf_shard[0], cc, axis,
-                                     first=first, wire_format=fmt)
+        with _guard.building(gbuild.cap, gbuild.deadline) if gbuild \
+                else contextlib.nullcontext():
+            res = ll_all_gather(x_shard, buf_shard[0], cc, axis,
+                                first=first, wire_format=fmt)
+        if gbuild is not None:
+            out, new_buf, gbuf = res
+            return out, new_buf[None], gbuf[None]
+        out, new_buf = res
         return out, new_buf[None]
 
+    out_specs = (P(None, axis), P(axis))
+    if gbuild is not None:
+        out_specs += (P(axis),)
     return jax.jit(
         jax.shard_map(
             per_device, mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
-            out_specs=(P(None, axis), P(axis)),
+            out_specs=out_specs,
             check_vma=False,
         ),
         donate_argnums=(1,),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _ll_xla_fn(mesh, axis: str, fmt=None):
+    """The degraded route: plain XLA all_gather of the (packed) shard —
+    identical output contract (and wire fidelity) to the LL kernel,
+    no Pallas protocol to hang. Collective entry points route here
+    once a guard trip degraded the protocol (fallback="xla")."""
+    from jax.sharding import PartitionSpec as P
+
+    f = wcodec.resolve(fmt)
+
+    def per_device(x_shard):
+        xw = wcodec.pack(x_shard, f)
+        g = jax.lax.all_gather(xw, axis)
+        if wcodec.is_native(f):
+            return g
+        n = jax.lax.axis_size(axis)
+        flat = g.reshape(n * g.shape[1], g.shape[2])
+        return wcodec.unpack(flat, x_shard.shape[1:], f,
+                             x_shard.dtype).reshape((n,) + x_shard.shape)
+
+    return jax.jit(
+        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis),
+                      out_specs=P(None, axis), check_vma=False))
+
+
+PROTOCOL_NAME = "low_latency_allgather"  # degradation-registry key
 
 
 def ll_all_gather_op(
@@ -193,6 +278,7 @@ def ll_all_gather_op(
     axis: str = TP_AXIS,
     name: str = "ll_ag",
     wire_format=None,
+    fallback=None,
 ):
     """Host-level LL allgather over a SymmetricWorkspace-owned context
     (the reference's FastAllGatherContext held by a layer context and
@@ -201,10 +287,22 @@ def ll_all_gather_op(
     P(axis); the context buffer persists inside `workspace` between jit
     invocations (donated in, aliased out, stored back via update()).
     wire_format: quantized contexts are namespaced per format (a
-    format switch is a fresh context, with its entry barrier)."""
+    format switch is a fresh context, with its entry barrier).
+
+    fallback="xla" is the guard-tripped degradation route
+    (docs/robustness.md): under an active guard build
+    (faults.guard.building), a watchdog trip inside the kernel marks
+    the protocol degraded and this call — and every later one — returns
+    the plain XLA all_gather result instead of raising, so a degraded
+    step completes rather than dies. Without fallback, a trip raises
+    DeadlineExceeded with the decoded guard rows."""
     n = int(mesh.shape[axis])
     loc_rows = x.shape[0] // n
     fmt = wcodec.resolve(wire_format)
+    if fallback not in (None, "xla"):
+        raise ValueError(f"unknown fallback {fallback!r} (None or 'xla')")
+    if fallback == "xla" and _guard.is_degraded(PROTOCOL_NAME):
+        return _ll_xla_fn(mesh, axis, fmt)(x)
     if wcodec.is_native(fmt):
         local_shape = (2, n, loc_rows) + tuple(x.shape[1:])
         buf_dtype = x.dtype
@@ -219,11 +317,27 @@ def ll_all_gather_op(
     # shape/name at a nonzero count still needs the one-time team sync
     fresh = not workspace.contains(name, local_shape, buf_dtype)
     buf = workspace.get(name, local_shape, buf_dtype)
-    out, new_buf = _ll_op_fn(mesh, axis, fmt)(
+    gbuild = _guard.active_build()
+    res = _ll_op_fn(mesh, axis, fmt, gbuild)(
         x, buf, jnp.asarray(call_count, jnp.int32),
         jnp.asarray(fresh, jnp.int32),
     )
+    if gbuild is None:
+        out, new_buf = res
+        workspace.update(name, new_buf)
+        return out
+    out, new_buf, gout = res
     workspace.update(name, new_buf)
+    import numpy as np
+
+    trips = _guard.decode(
+        np.asarray(gout).reshape(n, -1, _guard.GUARD_WORDS))
+    if trips:
+        if fallback == "xla":
+            _guard.degrade(PROTOCOL_NAME)
+            return _ll_xla_fn(mesh, axis, fmt)(x)
+        _guard.check(np.asarray(gout).reshape(
+            n, -1, _guard.GUARD_WORDS), context=PROTOCOL_NAME)
     return out
 
 

@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.lang import shmem
 from triton_dist_tpu.lang.core import (
     tpu_call,
@@ -49,14 +50,28 @@ class ReduceScatterMethod(enum.Enum):
     XLA = "xla"
 
 
+def _rs_unpack(casting, gbuild, refs):
+    """Shared ref unpacking of the two ring kernels: outputs (o_ref +
+    guard buffer) precede scratch; cast_buf and the guard cursor are
+    the trailing scratch entries."""
+    refs = list(refs)
+    x_ref, o_ref = refs[0], refs[1]
+    del refs[:2]
+    gbuf = refs.pop(0) if gbuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
+    cast_buf = refs.pop() if casting else None
+    acc, stage = refs[0], refs[1]
+    sems = refs[2:]
+    return x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage, sems
+
+
 # A ring step holds 3 chunk-sized VMEM buffers (2 accumulator slots + local
 # staging); above this chunk size fall back to psum_scatter.
 _VMEM_CHUNK_LIMIT = 4 * (1 << 20)
 
 
-def _ring_rs_kernel(axis: str, n: int, acc_dtype, x_ref, o_ref, acc,
-                    stage, ld_sem, st_sem, send_sem, recv_sem,
-                    credit_sem, cast_buf):
+def _ring_rs_kernel(axis: str, n: int, acc_dtype, casting, gbuild,
+                    *refs):
     """Ring reduce-scatter.
 
     Chunk schedule (mirrors the SM-ring of ref reduce_scatter.py:327-413):
@@ -88,82 +103,84 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, x_ref, o_ref, acc,
     conflated before the wire plane; they are orthogonal. Loads cast
     through cast_buf (DMA cannot cast); the output returns in x.dtype.
     """
+    (x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage,
+     (ld_sem, st_sem, send_sem, recv_sem, credit_sem)) = _rs_unpack(
+        casting, gbuild, refs)
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
-    casting = cast_buf is not None
-    shmem.neighbor_barrier(axis, me, n)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx):
+        shmem.neighbor_barrier(axis, me, n)
+        shmem.fault_delay(axis, "reduce_scatter")
 
-    # Step-0 incoming targets our slot 1, free from the start: grant
-    # credit. n == 1 (reachable via force_kernel) runs no ring step and
-    # must not leave a dangling credit at kernel exit — a leaked count
-    # in the physical semaphore pool could spuriously satisfy a later
-    # kernel's credit wait (the sem-leak class the verifier flags).
-    if n > 1:
-        pltpu.semaphore_signal(
-            credit_sem, inc=1, device_id={axis: left},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
+        # Step-0 incoming targets our slot 1, free from the start: grant
+        # credit. n == 1 (reachable via force_kernel) runs no ring step
+        # and must not leave a dangling credit at kernel exit — a leaked
+        # count in the physical semaphore pool could spuriously satisfy
+        # a later kernel's credit wait (the sem-leak class the verifier
+        # flags).
+        if n > 1:
+            shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left, axis,
+                         label="credit")
 
-    def load_chunk(chunk, dst):
-        """x[chunk] -> dst(acc_dtype), via cast_buf when dtypes differ.
-        Returns a finish() that must run before dst is read."""
-        tgt = cast_buf if casting else dst
-        cp = pltpu.make_async_copy(x_ref.at[pl.ds(chunk * m, m)], tgt,
-                                   ld_sem)
-        cp.start()
+        def load_chunk(chunk, dst):
+            """x[chunk] -> dst(acc_dtype), via cast_buf when dtypes
+            differ. Returns a finish() that must run before dst is
+            read."""
+            tgt = cast_buf if casting else dst
+            cp = pltpu.make_async_copy(x_ref.at[pl.ds(chunk * m, m)],
+                                       tgt, ld_sem)
+            cp.start()
 
-        def finish():
-            cp.wait()
-            if casting:
-                dst[...] = cast_buf[...].astype(acc_dtype)
+            def finish():
+                cp.wait()
+                if casting:
+                    dst[...] = cast_buf[...].astype(acc_dtype)
 
-        return finish
+            return finish
 
-    # Load our contribution to the first travelling chunk, (me-1) mod n.
-    load_chunk(jnp.mod(me - 1, n), acc.at[0])()
+        # Load our contribution to the first travelling chunk,
+        # (me-1) mod n.
+        load_chunk(jnp.mod(me - 1, n), acc.at[0])()
 
-    for s in range(n - 1):
-        cur, nxt = s % 2, (s + 1) % 2
-        pltpu.semaphore_wait(credit_sem, 1)  # right's slot `nxt` is free
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=acc.at[cur],
-            dst_ref=acc.at[nxt],
-            send_sem=send_sem,
-            recv_sem=recv_sem.at[nxt],
-            device_id={axis: right},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        # Prefetch our contribution to the incoming chunk while it travels.
-        chunk = jnp.mod(me - s - 2, n)
-        finish = load_chunk(chunk, stage)
-        rdma.wait_send()
-        if s + 1 <= n - 2:
-            # Slot `cur` is sent out: receivable for incoming step s+1
-            # (which targets (s+2)%2 == cur). Grant the left neighbor.
-            pltpu.semaphore_signal(
-                credit_sem, inc=1, device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-        rdma.wait_recv()
-        finish()
-        acc[nxt] = acc[nxt] + stage[...]
+        for s in range(n - 1):
+            cur, nxt = s % 2, (s + 1) % 2
+            shmem.guard_progress(s)
+            # right's slot `nxt` is free
+            shmem.signal_wait_until(credit_sem, shmem.CMP_GE, 1,
+                                    site="credit", slot=s)
+            h = shmem.putmem_nbi(acc.at[nxt], acc.at[cur], send_sem,
+                                 recv_sem.at[nxt], right, axis)
+            # Prefetch our contribution to the incoming chunk while it
+            # travels.
+            chunk = jnp.mod(me - s - 2, n)
+            finish = load_chunk(chunk, stage)
+            h.wait_send()
+            if s + 1 <= n - 2:
+                # Slot `cur` is sent out: receivable for incoming step
+                # s+1 (which targets (s+2)%2 == cur). Grant the left
+                # neighbor.
+                shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left,
+                             axis, label="credit")
+            h.wait_recv(slot=s)
+            finish()
+            acc[nxt] = acc[nxt] + stage[...]
 
-    final = (n - 1) % 2
-    if casting:
-        cast_buf[...] = acc[final].astype(o_ref.dtype)
-        st = pltpu.make_async_copy(cast_buf, o_ref, st_sem)
-    else:
-        st = pltpu.make_async_copy(acc.at[final], o_ref, st_sem)
-    st.start()
-    st.wait()
+        final = (n - 1) % 2
+        if casting:
+            cast_buf[...] = acc[final].astype(o_ref.dtype)
+            st = pltpu.make_async_copy(cast_buf, o_ref, st_sem)
+        else:
+            st = pltpu.make_async_copy(acc.at[final], o_ref, st_sem)
+        st.start()
+        st.wait()
 
 
-def _ring_rs_wire_kernel(axis: str, n: int, fmt, x_ref, o_ref, acc,
-                         stage, ld_sem, st_sem, send_sem, recv_sem,
-                         credit_sem, cast_buf):
+def _ring_rs_wire_kernel(axis: str, n: int, fmt, casting, gbuild,
+                         *refs):
     """Quantized-wire ring RS: the EXACT credit/parity protocol of
     `_ring_rs_kernel` — same puts, same per-parity recv semaphores,
     same credit flow toward the left neighbor (`verify` proves the
@@ -175,76 +192,80 @@ def _ring_rs_wire_kernel(axis: str, n: int, fmt, x_ref, o_ref, acc,
     the f32 contribution/accumulation buffer, and the LAST arrival is
     stored without a re-encode, so the output is exactly the f32 fold
     (wire.simulate_ring_rs replays this order bit-for-bit)."""
+    (x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage,
+     (ld_sem, st_sem, send_sem, recv_sem, credit_sem)) = _rs_unpack(
+        casting, gbuild, refs)
     me = jax.lax.axis_index(axis)
     m, k = stage.shape
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
-    casting = cast_buf is not None
-    shmem.neighbor_barrier(axis, me, n)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx):
+        shmem.neighbor_barrier(axis, me, n)
+        shmem.fault_delay(axis, "reduce_scatter")
 
-    # see _ring_rs_kernel: no dangling credit at n == 1 (force_kernel)
-    if n > 1:
-        pltpu.semaphore_signal(
-            credit_sem, inc=1, device_id={axis: left},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
+        # see _ring_rs_kernel: no dangling credit at n == 1
+        # (force_kernel)
+        if n > 1:
+            shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left, axis,
+                         label="credit")
 
-    def load_chunk(chunk):
-        """x[chunk] -> stage (f32), via cast_buf (DMA cannot cast).
-        Returns a finish() that must run before stage is read."""
-        tgt = cast_buf if casting else stage
-        cp = pltpu.make_async_copy(x_ref.at[pl.ds(chunk * m, m)], tgt,
-                                   ld_sem)
-        cp.start()
+        def load_chunk(chunk):
+            """x[chunk] -> stage (f32), via cast_buf (DMA cannot cast).
+            Returns a finish() that must run before stage is read."""
+            tgt = cast_buf if casting else stage
+            cp = pltpu.make_async_copy(x_ref.at[pl.ds(chunk * m, m)],
+                                       tgt, ld_sem)
+            cp.start()
 
-        def finish():
-            cp.wait()
-            if casting:
-                stage[...] = cast_buf[...].astype(jnp.float32)
+            def finish():
+                cp.wait()
+                if casting:
+                    stage[...] = cast_buf[...].astype(jnp.float32)
 
-        return finish
+            return finish
 
-    # Our contribution to the first travelling chunk: quantize at the
-    # send edge into the wire slot.
-    load_chunk(jnp.mod(me - 1, n))()
-    acc[0] = wcodec.encode_rows(stage[...], fmt)
+        # Our contribution to the first travelling chunk: quantize at
+        # the send edge into the wire slot.
+        load_chunk(jnp.mod(me - 1, n))()
+        acc[0] = wcodec.encode_rows(stage[...], fmt)
 
-    for s in range(n - 1):
-        cur, nxt = s % 2, (s + 1) % 2
-        pltpu.semaphore_wait(credit_sem, 1)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=acc.at[cur],
-            dst_ref=acc.at[nxt],
-            send_sem=send_sem,
-            recv_sem=recv_sem.at[nxt],
-            device_id={axis: right},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        finish = load_chunk(jnp.mod(me - s - 2, n))
-        rdma.wait_send()
-        if s + 1 <= n - 2:
-            pltpu.semaphore_signal(
-                credit_sem, inc=1, device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-        rdma.wait_recv()
-        finish()
-        # consume edge: dequantize the incoming hop, accumulate in f32
-        val = wcodec.decode_rows(acc[nxt], k, fmt, jnp.float32) \
-            + stage[...]
-        if s == n - 2:
-            stage[...] = val  # final arrival: no re-encode
+        for s in range(n - 1):
+            cur, nxt = s % 2, (s + 1) % 2
+            shmem.guard_progress(s)
+            shmem.signal_wait_until(credit_sem, shmem.CMP_GE, 1,
+                                    site="credit", slot=s)
+            h = shmem.putmem_nbi(acc.at[nxt], acc.at[cur], send_sem,
+                                 recv_sem.at[nxt], right, axis)
+            finish = load_chunk(jnp.mod(me - s - 2, n))
+            h.wait_send()
+            if s + 1 <= n - 2:
+                shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left,
+                             axis, label="credit")
+            h.wait_recv(slot=s)
+            finish()
+            # consume edge: verify integrity (checksum formats under a
+            # guard build: a corrupted hop becomes a guard row, not a
+            # silently wrong sum), dequantize, accumulate in f32
+            if gctx is not None and fmt.checksum:
+                _guard.integrity_trip(
+                    jnp.all(wcodec.verify_rows(acc[nxt], k, fmt)),
+                    slot=s, ctx=gctx)
+            val = wcodec.decode_rows(acc[nxt], k, fmt, jnp.float32) \
+                + stage[...]
+            if s == n - 2:
+                stage[...] = val  # final arrival: no re-encode
+            else:
+                acc[nxt] = wcodec.encode_rows(val, fmt)
+
+        if casting:
+            cast_buf[...] = stage[...].astype(o_ref.dtype)
+            st = pltpu.make_async_copy(cast_buf, o_ref, st_sem)
         else:
-            acc[nxt] = wcodec.encode_rows(val, fmt)
-
-    if casting:
-        cast_buf[...] = stage[...].astype(o_ref.dtype)
-        st = pltpu.make_async_copy(cast_buf, o_ref, st_sem)
-    else:
-        st = pltpu.make_async_copy(stage, o_ref, st_sem)
-    st.start()
-    st.wait()
+            st = pltpu.make_async_copy(stage, o_ref, st_sem)
+        st.start()
+        st.wait()
 
 
 def _wire_rs_xla(x: jax.Array, axis: str, n: int, fmt) -> jax.Array:
@@ -313,23 +334,25 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
                 f"{x.shape}")
         return _ring_rs_quantized(x, axis, n, fmt, force_kernel)
     acc_dtype = jnp.dtype(accum_dtype or x.dtype)
+    gbuild = _guard.active_build()
     if n == 1 and not force_kernel:
-        return x
+        return _guard.with_guard(gbuild, x)
     if interpret_no_headroom():
         if acc_dtype != x.dtype:
-            return jax.lax.psum_scatter(
-                x.astype(acc_dtype), axis, tiled=True).astype(x.dtype)
-        return jax.lax.psum_scatter(x, axis, tiled=True)
+            return _guard.with_guard(gbuild, jax.lax.psum_scatter(
+                x.astype(acc_dtype), axis, tiled=True).astype(x.dtype))
+        return _guard.with_guard(
+            gbuild, jax.lax.psum_scatter(x, axis, tiled=True))
     m = x.shape[0] // n
     chunk_shape = (m,) + x.shape[1:]
     casting = acc_dtype != x.dtype
-    kernel = functools.partial(_ring_rs_kernel, axis, n, acc_dtype)
-    if not casting:
-        inner = kernel
-
-        def kernel(*args):  # noqa: F811
-            return inner(*args, None)
-
+    kernel = functools.partial(_ring_rs_kernel, axis, n, acc_dtype,
+                               casting, gbuild)
+    out_shape = jax.ShapeDtypeStruct(chunk_shape, x.dtype)
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+    if gbuild is not None:
+        out_shape = (out_shape, _guard.out_shape(gbuild))
+        out_specs = (out_specs, _guard.out_spec())
     scratch = [
         pltpu.VMEM((2,) + chunk_shape, acc_dtype),
         pltpu.VMEM(chunk_shape, acc_dtype),
@@ -341,11 +364,13 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
     ]
     if casting:
         scratch.append(pltpu.VMEM(chunk_shape, x.dtype))
+    if gbuild is not None:
+        scratch.append(_guard.cursor_scratch())
     return tpu_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(chunk_shape, x.dtype),
+        out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=out_specs,
         scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True,
@@ -365,24 +390,25 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
     of the identical fold. n == 1 is a pass-through (no hop ever
     travels; the kernel still pays the send-edge encode when forced,
     which is what the bench's world=1 wire arm measures)."""
+    gbuild = _guard.active_build()
     if n == 1 and not force_kernel:
-        return x
+        return _guard.with_guard(gbuild, x)
     if interpret_no_headroom():
         if n == 1:
-            return x
-        return _wire_rs_xla(x, axis, n, fmt)
+            return _guard.with_guard(gbuild, x)
+        return _guard.with_guard(gbuild, _wire_rs_xla(x, axis, n, fmt))
     m = x.shape[0] // n
     flat = x.reshape(x.shape[0], -1)
     k = flat.shape[1]
     kw = wcodec.wire_cols(k, fmt)
     casting = x.dtype != jnp.float32
-    kernel = functools.partial(_ring_rs_wire_kernel, axis, n, fmt)
-    if not casting:
-        inner = kernel
-
-        def kernel(*args):  # noqa: F811
-            return inner(*args, None)
-
+    kernel = functools.partial(_ring_rs_wire_kernel, axis, n, fmt,
+                               casting, gbuild)
+    out_shape = jax.ShapeDtypeStruct((m, k), x.dtype)
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+    if gbuild is not None:
+        out_shape = (out_shape, _guard.out_shape(gbuild))
+        out_specs = (out_specs, _guard.out_spec())
     scratch = [
         pltpu.VMEM((2, m, kw), jnp.int8),     # travelling wire slots
         pltpu.VMEM((m, k), jnp.float32),      # f32 stage/accumulator
@@ -394,11 +420,13 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
     ]
     if casting:
         scratch.append(pltpu.VMEM((m, k), x.dtype))
-    out = tpu_call(
+    if gbuild is not None:
+        scratch.append(_guard.cursor_scratch())
+    res = tpu_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((m, k), x.dtype),
+        out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=out_specs,
         scratch_shapes=scratch,
         compiler_params=compiler_params(
             has_side_effects=True,
@@ -410,7 +438,9 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
                                        ((2, m, kw), jnp.int8))),
         ),
     )(flat)
-    return out.reshape((m,) + x.shape[1:])
+    out, gbuf = (res if gbuild is not None else (res, None))
+    out = out.reshape((m,) + x.shape[1:])
+    return _guard.with_guard(gbuild, out, gbuf)
 
 
 def reduce_scatter(
@@ -441,8 +471,8 @@ def reduce_scatter(
     if not wcodec.is_native(wire_format):
         # the quantized ring owns its own fallback routing (the XLA
         # psum_scatter cannot express per-hop requantization)
-        return ring_reduce_scatter(x, axis, accum_dtype=accum_dtype,
-                                   wire_format=wire_format)
+        return _guard.primary(ring_reduce_scatter(
+            x, axis, accum_dtype=accum_dtype, wire_format=wire_format))
     if method == ReduceScatterMethod.Auto:
         n = jax.lax.axis_size(axis)
         chunk_bytes = (x.size // n) * x.dtype.itemsize
@@ -456,7 +486,8 @@ def reduce_scatter(
             return jax.lax.psum_scatter(
                 x.astype(accum_dtype), axis, tiled=True).astype(x.dtype)
         return jax.lax.psum_scatter(x, axis, tiled=True)
-    return ring_reduce_scatter(x, axis, accum_dtype=accum_dtype)
+    return _guard.primary(
+        ring_reduce_scatter(x, axis, accum_dtype=accum_dtype))
 
 
 def reduce_scatter_op(

@@ -25,13 +25,17 @@ Semantics notes (differences from NVSHMEM, by design of the hardware):
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
-from typing import Any, Sequence, Union
+from typing import Any, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.faults import guard as _guard
+from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import _compat
 from triton_dist_tpu.verify import capture as _vcap
 
@@ -104,20 +108,43 @@ def team_linear_device_id(axes: Sequence[str], pe) -> dict:
 
 @dataclasses.dataclass(frozen=True)
 class PutHandle:
-    """Handle for a non-blocking put (ref: *_nbi variants + quiet)."""
+    """Handle for a non-blocking put (ref: *_nbi variants + quiet).
+
+    `recv_sem`/`elems`/`nbytes` describe the symmetric incoming payload
+    so an active guard build (faults.guard) can bound the delivery wait:
+    readiness is `recv_sem >= amount` where the amount is the element
+    count under the interpreter's discharge and the byte count on
+    hardware (what the DMA semaphore actually tallies in each world)."""
 
     copy: Any
+    recv_sem: Any = None
+    elems: int = 0
+    nbytes: int = 0
+
+    def _recv_amount(self) -> int:
+        from triton_dist_tpu.lang.core import use_interpret
+
+        return self.elems if use_interpret() else self.nbytes
 
     def wait_send(self):
         self.copy.wait_send()
 
-    def wait_recv(self):
+    def wait_recv(self, slot=0):
         """Wait for the symmetric incoming payload on this device's recv_sem
-        (every rank runs the same program, so 'my put's recv' is 'my inbox')."""
-        self.copy.wait_recv()
+        (every rank runs the same program, so 'my put's recv' is 'my inbox').
+
+        Under an active guard build this is a bounded watchdog wait: on
+        deadline the kernel records a structured guard row and continues
+        instead of hanging (the host raises DeadlineExceeded)."""
+        if _guard.current() is None or self.recv_sem is None:
+            self.copy.wait_recv()
+            return
+        _guard.watchdog_wait(self.copy.wait_recv, self.recv_sem,
+                             self._recv_amount(), "recv", slot=slot)
 
     def wait(self):
-        self.copy.wait()
+        self.wait_send()
+        self.wait_recv()
 
 
 def putmem_nbi(
@@ -148,7 +175,9 @@ def putmem_nbi(
         device_id_type=id_type,
     )
     copy.start()
-    return PutHandle(copy)
+    elems = int(math.prod(src_ref.shape))
+    return PutHandle(copy, recv_sem=recv_sem, elems=elems,
+                     nbytes=elems * jnp.dtype(src_ref.dtype).itemsize)
 
 
 def putmem(dst_ref, src_ref, send_sem, recv_sem, pe, axis: AxisName) -> None:
@@ -185,12 +214,32 @@ def putmem_signal_nbi(
     return h
 
 
-def signal(sig_sem, value, sig_op, pe, axis: AxisName) -> None:
+def _fault_signal_mask(value, axis: AxisName, label: Optional[str]):
+    """Apply an active FaultPlan's dropped-signal fault: the faulted
+    rank's inc masks to 0 (VALUE-level — never control-flow divergence,
+    which would hang the legacy interpreter's lockstep discharge). No
+    plan -> the value passes through untouched (zero cost off)."""
+    plan = _fplan.active()
+    if plan is None:
+        return value
+    r = plan.dropped_signal_rank(label)
+    if r is None:
+        return value
+    me = jax.lax.axis_index(axis) if isinstance(axis, str) else \
+        jax.lax.axis_index(tuple(axis)[0])
+    return jnp.where(me == r, 0, jnp.asarray(value, jnp.int32))
+
+
+def signal(sig_sem, value, sig_op, pe, axis: AxisName,
+           label: Optional[str] = None) -> None:
     """Remote signal op on `pe`'s semaphore (ref: nvshmemx_signal_op).
 
     TPU semaphores are counting: only ADD is native. SET is accepted solely
     for the ubiquitous "set flag to 1 on a zeroed semaphore" pattern, where
-    it equals ADD 1 — enforced below."""
+    it equals ADD 1 — enforced below.
+
+    `label` classifies the site ("credit", "barrier", ...) for the
+    fault plane's DroppedSignal scheduling (faults/plan.py)."""
     assert sig_op in (SIGNAL_SET, SIGNAL_ADD), f"unknown sig_op {sig_op}"
     if sig_op == SIGNAL_SET:
         assert isinstance(value, int) and value == 1, (
@@ -203,7 +252,7 @@ def signal(sig_sem, value, sig_op, pe, axis: AxisName) -> None:
         return
     pltpu.semaphore_signal(
         sig_sem,
-        inc=value,
+        inc=_fault_signal_mask(value, axis, label),
         device_id=team_device_id(axis, pe),
         device_id_type=pltpu.DeviceIdType.MESH,
     )
@@ -218,18 +267,29 @@ def signal_local(sig_sem, value=1) -> None:
     pltpu.semaphore_signal(sig_sem, inc=value)
 
 
-def signal_wait_until(sig_sem, cmp, value) -> None:
+def signal_wait_until(sig_sem, cmp, value, site: str = "wait",
+                      slot=0) -> None:
     """Wait for local semaphore (ref: nvshmem_signal_wait_until).
 
     Consuming wait: decrements by `value` once satisfied (see module doc).
     Only CMP_GE is supported — TPU semaphore waits are ">= then subtract";
-    NVSHMEM's EQ (wait for exact value, non-consuming) cannot be expressed."""
+    NVSHMEM's EQ (wait for exact value, non-consuming) cannot be expressed.
+
+    Under an active guard build (faults.guard.building) this is a
+    bounded watchdog wait classified at `site` ("wait"/"credit"/...):
+    on deadline the kernel records a structured guard row — rank, site,
+    slot, progress, expected, observed — and continues instead of
+    hanging; the host raises DeadlineExceeded from the decoded row."""
     assert cmp == CMP_GE, "TPU signal_wait_until supports CMP_GE only"
     cap = _vcap.active()
     if cap is not None:
         cap.wait(sig_sem, value)
         return
-    pltpu.semaphore_wait(sig_sem, value)
+    if _guard.current() is None:
+        pltpu.semaphore_wait(sig_sem, value)
+        return
+    _guard.watchdog_wait(lambda: pltpu.semaphore_wait(sig_sem, value),
+                         sig_sem, value, site, slot=slot)
 
 
 def signal_read(sig_sem) -> jax.Array:
@@ -240,7 +300,8 @@ def signal_read(sig_sem) -> jax.Array:
             "control flow the verifier cannot see) — protocols under "
             "verify.capturing() must be wait-structured"
         )
-    return pl.semaphore_read(sig_sem)
+    read = getattr(pltpu, "semaphore_read", None) or pl.semaphore_read
+    return read(sig_sem)
 
 
 def fence() -> None:
@@ -276,10 +337,12 @@ def barrier_all(axis: AxisName) -> None:
             n = n * jax.lax.axis_size(ax)
 
     def with_sem(bsem):
+        inc = _fault_signal_mask(1, axis, "barrier")
+
         def body(i, _):
             pltpu.semaphore_signal(
                 bsem,
-                inc=1,
+                inc=inc,
                 device_id=team_device_id(axis, i)
                 if isinstance(axis, str)
                 else team_linear_device_id(axis, i),
@@ -288,7 +351,11 @@ def barrier_all(axis: AxisName) -> None:
             return _
 
         jax.lax.fori_loop(0, n, body, None)
-        pltpu.semaphore_wait(bsem, n)
+        if _guard.current() is None:
+            pltpu.semaphore_wait(bsem, n)
+        else:
+            _guard.watchdog_wait(lambda: pltpu.semaphore_wait(bsem, n),
+                                 bsem, n, "barrier")
 
     _compat.scoped_collective_sem(with_sem)
 
@@ -311,12 +378,17 @@ def neighbor_barrier(axis: str, me, n: int) -> None:
         return
 
     def with_sem(bsem):
+        inc = _fault_signal_mask(1, axis, "barrier")
         for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
             pltpu.semaphore_signal(
-                bsem, inc=1, device_id={axis: d},
+                bsem, inc=inc, device_id={axis: d},
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
-        pltpu.semaphore_wait(bsem, 2)
+        if _guard.current() is None:
+            pltpu.semaphore_wait(bsem, 2)
+        else:
+            _guard.watchdog_wait(lambda: pltpu.semaphore_wait(bsem, 2),
+                                 bsem, 2, "barrier")
 
     _compat.scoped_collective_sem(with_sem)
 
@@ -374,6 +446,27 @@ def straggler_delay(axis: AxisName, rank, nanos: int, sem=None) -> None:
                 with_sem(sem)
         else:
             pl.delay(nanos)
+
+
+def fault_delay(axis: AxisName, protocol: str, sem=None) -> None:
+    """Inject the active FaultPlan's scheduled straggler for `protocol`
+    (DelayedSend / StalledRank -> straggler_delay at the faulted rank).
+    Kernels without their own straggler= hook call this once after
+    their entry barrier; no active plan is a trace-time no-op (the
+    zero-cost-off contract)."""
+    plan = _fplan.active()
+    if plan is None:
+        return
+    s = plan.straggler_for(protocol)
+    if s is not None:
+        straggler_delay(axis, s[0], s[1], sem=sem)
+
+
+def guard_progress(value) -> None:
+    """Record the kernel's progress counter (ring step, chunk id) into
+    the ambient guard context — watchdog trips report it. No active
+    guard build: trace-time no-op."""
+    _guard.set_progress(value)
 
 
 def getmem_nbi(

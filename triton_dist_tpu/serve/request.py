@@ -25,6 +25,9 @@ class RequestState(enum.Enum):
     DECODE = "decode"        # one token per scheduler step
     FINISHED = "finished"    # eos / max_new_tokens reached
     CANCELLED = "cancelled"  # dropped by the client
+    FAILED = "failed"        # quarantined by the degradation ladder
+    # (the scheduler attributed a repeated step failure to this request
+    # and retired it so the survivors could proceed — docs/robustness.md)
 
 
 _END = object()  # stream sentinel
@@ -107,7 +110,8 @@ class Request:
     @property
     def done(self) -> bool:
         return self.state in (RequestState.FINISHED,
-                              RequestState.CANCELLED)
+                              RequestState.CANCELLED,
+                              RequestState.FAILED)
 
     # -- latency metrics ------------------------------------------------
 

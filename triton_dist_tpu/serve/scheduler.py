@@ -22,6 +22,17 @@ of that loop). Policies:
                 uninterrupted run (engine.make_serve_step).
   completion  — eos_id or max_new_tokens; the slot and its pages free
                 immediately (free-on-finish).
+  degradation — a step failure (faults.FaultError: a guard watchdog's
+                DeadlineExceeded, a WireIntegrityError, an injected
+                chaos fault) never kills the batch: the step retries
+                with bounded exponential backoff; when retries exhaust,
+                the most recently admitted request in the failing step
+                is QUARANTINED (retired as FAILED — the newest arrival
+                is the most likely poisoner, the survivors were running
+                fine before it) and the survivors continue next step.
+                Every retry and quarantine lands in the host-span
+                timeline, so recoveries are attributable in Perfetto
+                (docs/robustness.md "degradation ladder").
 
 Tokens stream per request (callback/iterator, incremental
 detokenization) and every lifecycle phase is recorded as a host span
@@ -37,6 +48,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from triton_dist_tpu.faults.errors import FaultError
 from triton_dist_tpu.serve.kv_pool import KVPool, PoolExhausted, pages_for
 from triton_dist_tpu.serve.queue import RequestQueue
 from triton_dist_tpu.serve.request import (
@@ -68,6 +80,8 @@ class Scheduler:
         max_active: Optional[int] = None,
         queue: Optional[RequestQueue] = None,
         detokenizer: Optional[Detokenizer] = None,
+        max_step_retries: int = 2,
+        retry_backoff_s: float = 0.005,
     ):
         page = page or _default_page(engine.max_len)
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
@@ -98,11 +112,19 @@ class Scheduler:
             chunk = max(1, min(chunk, self.pool.t_max))
         self.chunk = chunk
         self.worker = Worker(engine, self.pool, chunk)
-        self.queue = queue or RequestQueue()
+        # `queue or ...` would silently DISCARD a custom queue that is
+        # currently empty (RequestQueue defines __len__, and an empty
+        # queue is falsy) — the admission-control settings a caller
+        # configured (max_pending backpressure) would vanish
+        self.queue = queue if queue is not None else RequestQueue()
         self.max_active = max_active or slots
         self.detok = detokenizer
         self.active: dict = {}  # slot -> Request
         self.requests: List[Request] = []
+        self.quarantined: List[Request] = []
+        self.max_step_retries = max_step_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.n_step_retries = 0
         self._admit_seq = 0
         self._spans: List[tuple] = []
         self._thread: Optional[threading.Thread] = None
@@ -215,7 +237,12 @@ class Scheduler:
             self._evict(victim)
             return True
 
-        toks = self.worker.step(tokens, n_valid, temps, keys)
+        toks = self._run_step(tokens, n_valid, temps, keys, plans)
+        if toks is None:
+            # step failed beyond its retry budget; the poisoning
+            # request is quarantined — survivors rerun next step from
+            # unchanged pool state (Worker.step's failure contract)
+            return True
 
         for slot, req, n, emits in plans:
             req.last_active_step = self.worker.n_steps
@@ -228,6 +255,40 @@ class Scheduler:
             else:
                 self._emit(req, int(toks[slot]))
         return True
+
+    def _run_step(self, tokens, n_valid, temps, keys, plans):
+        """The degradation ladder around the device step: bounded
+        exponential-backoff retries, then quarantine of the suspected
+        poisoner. Returns the per-slot tokens, or None when the step
+        was abandoned this round (survivors rerun next step). Only
+        FaultError is degradable — a programming error stays loud."""
+        delay = self.retry_backoff_s
+        last_err = None
+        for attempt in range(self.max_step_retries + 1):
+            t0 = time.perf_counter_ns()
+            try:
+                return self.worker.step(tokens, n_valid, temps, keys)
+            except FaultError as e:
+                last_err = e
+                self.n_step_retries += 1
+                self._spans.append(
+                    (f"step/retry{attempt}", t0, time.perf_counter_ns()))
+                if attempt < self.max_step_retries:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 0.25)
+        victim = max((req for _slot, req, _n, _e in plans),
+                     key=lambda r: r.admit_seq)
+        self._quarantine(victim, last_err)
+        return None
+
+    def _quarantine(self, req: Request, err) -> None:
+        """Retire the suspected poisoner as FAILED (stream closes, the
+        client unblocks with a structured reason); its pages feed the
+        survivors."""
+        now = time.perf_counter_ns()
+        self._spans.append((f"req{req.request_id}/quarantined", now, now))
+        self.quarantined.append(req)
+        self._retire(req, f"quarantined: {err!r}", RequestState.FAILED)
 
     def run(self, max_steps: int = 100_000) -> None:
         """Drive steps until queue and slots drain."""
@@ -282,7 +343,10 @@ class Scheduler:
     # -- metrics / observability ---------------------------------------
 
     def metrics(self) -> dict:
-        return summarize(self.requests)
+        out = summarize(self.requests)
+        out["quarantined"] = len(self.quarantined)
+        out["step_retries"] = self.n_step_retries
+        return out
 
     def timeline(self):
         """Per-request lifecycle spans as a trace.Timeline (host spans

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.serve.kv_pool import KVPool
 
 
@@ -41,7 +42,19 @@ class Worker:
         temps (K,) f32 / keys (K, 2) u32. Advances pool lengths by
         n_valid and returns the per-slot next token (K,) i32 — only
         slots whose chunk just completed (prefill tail or decode) carry
-        a meaningful token; the scheduler knows which."""
+        a meaningful token; the scheduler knows which.
+
+        Failure contract: raises BEFORE touching pool state (lengths
+        advance only on success), so a failed step is safely retryable
+        — the scheduler's degradation ladder depends on it. An active
+        FaultPlan's FailStep(at_step=n_steps) injects the failure here
+        (n_steps counts SUCCESSFUL steps, so `times` controls how many
+        consecutive retries the injected fault survives)."""
+        plan = _fplan.active()
+        if plan is not None:
+            err = plan.step_fault(self.n_steps)
+            if err is not None:
+                raise err
         pool = self.pool
         tok, _logits, pool.k, pool.v = self._fn(
             self.engine.params,

@@ -307,3 +307,21 @@ def prefetch_hit_rate(tl: Timeline,
             total += 1
             hits += 1 if e.payload > 0 else 0
     return (hits / total) if total else None
+
+
+def guard_trips(tl: Timeline) -> List[dict]:
+    """The guard-trip instants of a timeline (kernels built under BOTH
+    trace.building and faults.guard.building emit one per watchdog
+    trip): [{rank, site, slot, t}] rows, the trace-side view of the
+    guard rows the host raised on — every recovery the degradation
+    ladder performs is attributable next to the stalls that caused it
+    (docs/robustness.md)."""
+    from triton_dist_tpu.faults.guard import site_name
+
+    rid = ev.REGIONS["guard.trip"]
+    return [
+        {"rank": e.rank, "site": site_name(e.payload), "slot": e.aux,
+         "t": e.t}
+        for e in tl.events
+        if e.region == rid and e.kind == ev.KIND_INSTANT
+    ]

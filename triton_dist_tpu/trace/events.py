@@ -88,6 +88,12 @@ REGIONS = {
     "fp.send": 18,       # flash-prefill segment DMA issued (payload=offset)
     "fp.wait": 19,       # flash-prefill segment delivery wait (payload=offset)
     "fp.fold": 20,       # flash-prefill per-segment fold (payload=offset)
+    "guard.trip": 21,    # watchdog trip (payload=site id, aux=slot) —
+    # emitted when a kernel carries BOTH a trace ctx and a guard ctx
+    # (faults/guard.py), so every recovery is attributable in Perfetto
+    "fault.inject": 22,  # host-side fault-injection instant (chaos
+    # plane / scheduler quarantine markers ride host spans; this region
+    # tags in-band injection points)
 }
 _REGION_NAMES = {v: k for k, v in REGIONS.items()}
 

@@ -62,6 +62,13 @@ from triton_dist_tpu.verify.engine import (  # noqa: F401
     run_protocol,
 )
 from triton_dist_tpu.verify.hb import CycleError, HBGraph  # noqa: F401
+from triton_dist_tpu.verify.liveness import (  # noqa: F401
+    DROP_DELIVERY,
+    DROP_SIGNAL,
+    check_liveness,
+    liveness_cells,
+    run_faulted,
+)
 from triton_dist_tpu.verify.registry import (  # noqa: F401
     FORMAT_PARAM,
     ProtocolSpec,

@@ -59,7 +59,11 @@ from triton_dist_tpu.verify.hb import HBGraph
 DEADLOCK = "deadlock"
 RACE = "data-race"
 LEAK = "sem-leak"
-CLASSES = (DEADLOCK, RACE, LEAK)
+# dynamic class: a watchdog that fails to trip on a real lost signal
+# (the guard-polarity mutants — evaluated by the chaos harness, not the
+# HB engine; registry.verify_spec dispatches on it)
+GUARD = "guard-no-trip"
+CLASSES = (DEADLOCK, RACE, LEAK, GUARD)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,7 +184,11 @@ def _slot_statics(progs: List[List[COp]]) -> Dict[tuple, _SlotInfo]:
         for op in prog:
             if op.kind == cap.PUT:
                 add(op.rank, op.f["send_sem"], op, 1)
-                add(op.f["pe"], op.f["recv_sem"], op, 1)
+                # a delivery-dropped put (the liveness checker's lost-DMA
+                # fault model, verify/liveness.py) completes locally but
+                # never lands: no recv production
+                if not op.f.get("dropped"):
+                    add(op.f["pe"], op.f["recv_sem"], op, 1)
             elif op.kind == cap.COPY:
                 add(op.rank, op.f["sem"], op, 1)
             elif op.kind == cap.SIGNAL:
@@ -278,16 +286,18 @@ def execute(progs: List[List[COp]]) -> Execution:
         if op.kind == cap.PUT:
             p = node(r, ("put", r, op.sid))
             s_nd = g.add_node(("send_done", r, op.sid))
-            d_nd = g.add_node(("delivery", r, op.sid))
             g.add_edge(p, s_nd)
-            g.add_edge(p, d_nd)
             access("r", r, op.f["src"], s_nd,
                    f"put src read of {op.f['src']}")
-            access("w", op.f["pe"], op.f["dst"], d_nd,
-                   f"delivery write of {op.f['dst']} from rank {r}")
             produce(r, op.f["send_sem"], 1, s_nd)
-            produce(op.f["pe"], op.f["recv_sem"], 1, d_nd)
-            dmeta[d_nd] = dict(sender=r, dst=op.f["dst"], put_tag=op.tag)
+            if not op.f.get("dropped"):
+                d_nd = g.add_node(("delivery", r, op.sid))
+                g.add_edge(p, d_nd)
+                access("w", op.f["pe"], op.f["dst"], d_nd,
+                       f"delivery write of {op.f['dst']} from rank {r}")
+                produce(op.f["pe"], op.f["recv_sem"], 1, d_nd)
+                dmeta[d_nd] = dict(sender=r, dst=op.f["dst"],
+                                   put_tag=op.tag)
         elif op.kind == cap.COPY:
             st = node(r, ("copy", r, op.sid))
             c_nd = g.add_node(("copy_done", r, op.sid))

@@ -1,0 +1,158 @@
+"""Liveness checking under symbolic fault models.
+
+The static verifier (verify/engine.py) proves the SHIPPED protocols
+clean; this module proves something stronger about their failure
+behavior: inject a fault into the concretized program — a dropped
+explicit signal, or a put whose delivery never lands (the lost-DMA
+model: send completes locally, the destination write and recv-semaphore
+token never happen) — and demand the engine DETECTS it:
+
+    dropped signal / dropped delivery  ->  DEADLOCK (a wait can never
+                                           satisfy) or RACE (a consumer
+                                           read lost its ordering edge)
+
+A fault cell where the faulted execution completes with neither finding
+is a SILENT fault: the protocol would return a wrong answer without any
+diagnostic — exactly the failure class the runtime watchdogs
+(faults/guard.py) exist to kill, proven absent here at the model level.
+For a shipped (leak-free) protocol every signal and delivery is
+load-bearing, so every cell must detect; `check_liveness` returns the
+cells that do not, as problem strings (empty = liveness holds).
+
+Faults are injected on ONE rank (default rank 0): the programs are
+SPMD-symmetric, so rank 0's k-th signal is representative of every
+rank's. Barriers are excluded — capture models `barrier_all` as an
+atomic cut, which has no single signal to drop (the runtime drop of a
+barrier CONTRIBUTION is covered dynamically by the chaos plane's
+DroppedSignal(label="barrier") cells).
+
+Wired into `scripts/verify_kernels.py --liveness` and the dryrun chaos
+plane; tests/test_faults.py carries the polarity corpus (a protocol
+with a genuinely slack signal must be flagged as silent-under-fault).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from triton_dist_tpu.verify import capture as cap
+from triton_dist_tpu.verify import engine
+
+DROP_SIGNAL = "drop_signal"
+DROP_DELIVERY = "drop_delivery"
+FAULT_KINDS = (DROP_SIGNAL, DROP_DELIVERY)
+
+
+def fault_sites(progs, rank: int = 0) -> List[Tuple[str, int]]:
+    """(kind, pidx) fault candidates on `rank`'s concretized program:
+    every explicit signal (drop it) and every put (drop its delivery)."""
+    out: List[Tuple[str, int]] = []
+    for op in progs[rank]:
+        if op.kind == cap.SIGNAL:
+            out.append((DROP_SIGNAL, op.pidx))
+        elif op.kind == cap.PUT:
+            out.append((DROP_DELIVERY, op.pidx))
+    return out
+
+
+def apply_fault(progs, rank: int, kind: str, pidx: int):
+    """A faulted copy of the per-rank programs: DROP_SIGNAL removes the
+    op (the signal never fires); DROP_DELIVERY marks the put so the
+    engine produces its send completion but never the destination write
+    or recv token."""
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}")
+    out = []
+    for r, prog in enumerate(progs):
+        if r != rank:
+            out.append(list(prog))
+            continue
+        ops = []
+        for op in prog:
+            if op.pidx == pidx:
+                if kind == DROP_SIGNAL:
+                    if op.kind != cap.SIGNAL:
+                        raise ValueError(
+                            f"op #{pidx} on rank {rank} is {op.kind}, "
+                            "not a signal")
+                    continue
+                if op.kind != cap.PUT:
+                    raise ValueError(
+                        f"op #{pidx} on rank {rank} is {op.kind}, "
+                        "not a put")
+                op = dataclasses.replace(op, f=dict(op.f, dropped=True))
+            ops.append(op)
+        out.append(ops)
+    return out
+
+
+def run_faulted(fn, n: int, kind: str, pidx: int, rank: int = 0,
+                **params) -> engine.Execution:
+    """Concretize fn(n, **params), inject one fault, execute, attach
+    race findings — the single-cell entry the tests use."""
+    with cap.capturing(n) as c:
+        fn(n, **params)
+    progs = engine.concretize(c.ops, n)
+    ex = engine.execute(apply_fault(progs, rank, kind, pidx))
+    ex.findings.extend(engine.check_races(ex))
+    return ex
+
+
+def _detected(ex: engine.Execution) -> bool:
+    return any(f.klass in (engine.DEADLOCK, engine.RACE)
+               for f in ex.findings)
+
+
+def liveness_cells(fn, n: int, rank: int = 0,
+                   max_sites: Optional[int] = None, **params):
+    """Every (kind, pidx, detected) cell for one protocol
+    concretization."""
+    with cap.capturing(n) as c:
+        fn(n, **params)
+    progs = engine.concretize(c.ops, n)
+    sites = fault_sites(progs, rank)
+    if max_sites is not None:
+        sites = sites[:max_sites]
+    cells = []
+    for kind, pidx in sites:
+        ex = engine.execute(apply_fault(progs, rank, kind, pidx))
+        ex.findings.extend(engine.check_races(ex))
+        cells.append((kind, pidx, _detected(ex)))
+    return cells
+
+
+def check_liveness(names=None, ns: Tuple[int, ...] = (2, 4),
+                   rank: int = 0,
+                   max_sites: Optional[int] = None) -> List[str]:
+    """Sweep every registered shipped protocol's fault sites at the
+    given team sizes; return the SILENT cells as problem strings
+    (empty = every injected fault maps to a detected deadlock or race,
+    never a silent wrong answer)."""
+    from triton_dist_tpu.verify import registry
+
+    reg = registry.load_shipped()
+    if names:
+        missing = sorted(set(names) - set(reg))
+        if missing:
+            raise KeyError(f"unknown protocol(s) {missing}; "
+                           f"registered: {sorted(reg)}")
+        reg = {k: reg[k] for k in names}
+    problems: List[str] = []
+    for name in sorted(reg):
+        spec = reg[name]
+        for n in ns:
+            if n not in spec.ns:
+                continue
+            for params in spec.grid:
+                for kind, pidx, ok in liveness_cells(
+                        spec.fn, n, rank=rank, max_sites=max_sites,
+                        **params):
+                    if not ok:
+                        problems.append(
+                            f"{name} n={n} {dict(params)}: {kind} at "
+                            f"rank {rank} op #{pidx} was SILENT — the "
+                            "faulted run completed with no deadlock or "
+                            "race finding (a lost message would return "
+                            "a wrong answer undiagnosed)")
+    return problems

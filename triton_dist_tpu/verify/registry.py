@@ -106,12 +106,22 @@ def mutants() -> Dict[str, ProtocolSpec]:
 
 def verify_spec(spec: ProtocolSpec) -> List[engine.Finding]:
     """All findings for one registered protocol across its team sizes
-    and parameter grid."""
+    and parameter grid. GUARD-class mutants are DYNAMIC: their fn runs
+    the real kernels under fault injection (faults/chaos.py) and
+    returns its own findings instead of being captured symbolically."""
     out: List[engine.Finding] = []
     for n in spec.ns:
         for params in spec.grid:
-            out.extend(engine.check_protocol(
-                spec.fn, n, name=spec.name, **params))
+            if spec.expect == engine.GUARD:
+                import dataclasses as _dc
+
+                ptup = tuple(sorted(params.items()))
+                out.extend(
+                    _dc.replace(f, kernel=spec.name, n=n, params=ptup)
+                    for f in spec.fn(n, **params))
+            else:
+                out.extend(engine.check_protocol(
+                    spec.fn, n, name=spec.name, **params))
     return out
 
 

@@ -24,6 +24,7 @@ wire" has the bytes-by-precision rooflines and the measured columns.
 """
 
 from triton_dist_tpu.wire.codec import (  # noqa: F401
+    CHECKSUM_BYTES,
     FP8,
     FP8_MAX,
     INT8,
@@ -33,6 +34,8 @@ from triton_dist_tpu.wire.codec import (  # noqa: F401
     SCALE_BYTES,
     SCALE_EPS,
     WireFormat,
+    unpack_checked,
+    verify_rows,
     decode_rows,
     dequantize,
     encode_rows,

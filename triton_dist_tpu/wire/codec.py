@@ -47,6 +47,7 @@ INT8_MAX = 127.0
 # scale floor — keeps all-zero blocks finite (legacy ep_a2a constant)
 SCALE_EPS = 1e-12
 SCALE_BYTES = 4  # one f32 scale per block
+CHECKSUM_BYTES = 4  # one i32 byte-sum per row (checksum formats)
 LANE = 128       # TPU lane width; wire rows pad to a multiple
 
 _KINDS = ("native", "fp8", "int8")
@@ -58,10 +59,18 @@ class WireFormat:
     scale granularity along the (flattened) last axis — None means one
     scale per row (the legacy ep_a2a per-token scheme); an int block
     must divide the row width. Hashable/frozen so it can ride jit
-    closure keys and autotuner cache keys."""
+    closure keys and autotuner cache keys.
+
+    `checksum=True` reserves CHECKSUM_BYTES more columns per row for a
+    per-row byte-sum riding the same metadata-row idiom as the scales:
+    one put, one delivery semaphore, and an integrity verdict at the
+    consume edge (`verify_rows` / `unpack_checked` — a corrupted
+    payload or scale stripe raises WireIntegrityError instead of
+    dequantizing garbage; docs/robustness.md)."""
 
     kind: str = "native"
     block: Optional[int] = None
+    checksum: bool = False
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -69,6 +78,10 @@ class WireFormat:
                 f"unknown wire format kind {self.kind!r} (one of {_KINDS})")
         if self.block is not None and self.block <= 0:
             raise ValueError(f"wire block must be positive, got {self.block}")
+        if self.checksum and self.kind == "native":
+            raise ValueError(
+                "checksum rides the wire image; the native format has "
+                "none (move the tensor itself)")
 
 
 NATIVE = WireFormat("native")
@@ -121,13 +134,16 @@ def n_blocks(h: int, fmt: WireFormatLike) -> int:
 
 def wire_cols(h: int, fmt: WireFormatLike) -> int:
     """Wire-image row width (int8 columns) for a logical row of h
-    elements: payload bytes + bitcast f32 scales, padded to the lane
-    width. Native format has no wire image (raises)."""
+    elements: payload bytes + bitcast f32 scales (+ the per-row
+    checksum word of checksum formats), padded to the lane width.
+    Native format has no wire image (raises)."""
     f = resolve(fmt)
     if f.kind == "native":
         raise ValueError("native wire has no packed image; move the "
                          "tensor itself")
     used = h + SCALE_BYTES * n_blocks(h, f)
+    if f.checksum:
+        used += CHECKSUM_BYTES
     return -(-used // LANE) * LANE
 
 
@@ -193,11 +209,48 @@ def dequantize(q: jax.Array, scale: jax.Array, fmt: WireFormatLike,
     return out.astype(out_dtype)
 
 
+def _row_checksum(body: jax.Array) -> jax.Array:
+    """Per-row i32 byte-sum over payload + scale columns — a single
+    flipped bit changes exactly one byte, so the sum always moves (the
+    detection this plane needs; not cryptographic)."""
+    return jnp.sum(body.astype(jnp.int32), axis=-1)
+
+
+def _maybe_corrupt(img: jax.Array, h: int, scale_cols: int) -> jax.Array:
+    """Apply an active FaultPlan's scheduled wire bit-flips at the
+    FIRST send-edge encode of the traced program (after checksum
+    embedding, so integrity checking can see them). No plan: the image
+    passes through untouched (zero cost off)."""
+    from triton_dist_tpu.faults import plan as _fplan
+
+    plan = _fplan.active()
+    if plan is None:
+        return img
+    flips = plan.take_wire_flips()
+    if not flips:
+        return img
+    import numpy as np
+
+    from triton_dist_tpu.faults.plan import BitFlipScale
+
+    for fl in flips:
+        if isinstance(fl, BitFlipScale):
+            c = h + min(max(fl.byte, 0), scale_cols - 1)
+        else:
+            c = min(max(fl.byte, 0), h - 1)
+        r = min(max(fl.row, 0), img.shape[0] - 1)
+        mask = int(np.uint8(1 << (fl.bit % 8)).astype(np.int8))
+        img = img.at[r, c].set(
+            jnp.bitwise_xor(img[r, c], jnp.int8(mask)))
+    return img
+
+
 def encode_rows(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
     """(rows, H) float -> (rows, wire_cols) int8 wire image: payload
-    bytes, then the f32 scales bitcast into byte columns, then zero
-    lane padding. Pure jnp — usable on host arrays and on VMEM values
-    inside Pallas kernel bodies (the send edge)."""
+    bytes, then the f32 scales bitcast into byte columns (then the
+    per-row checksum word on checksum formats), then zero lane padding.
+    Pure jnp — usable on host arrays and on VMEM values inside Pallas
+    kernel bodies (the send edge)."""
     f = resolve(fmt)
     q, s = quantize(x, f)
     m, h = x.shape
@@ -206,9 +259,18 @@ def encode_rows(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
     else:
         qb = q
     sb = jax.lax.bitcast_convert_type(s, jnp.int8).reshape(m, -1)
-    pad = wire_cols(h, f) - h - sb.shape[1]
-    return jnp.concatenate(
-        [qb, sb, jnp.zeros((m, pad), jnp.int8)], axis=-1)
+    body = jnp.concatenate([qb, sb], axis=-1)
+    used = h + sb.shape[1]
+    if f.checksum:
+        cb = jax.lax.bitcast_convert_type(
+            _row_checksum(body)[:, None], jnp.int8).reshape(
+                m, CHECKSUM_BYTES)
+        body = jnp.concatenate([body, cb], axis=-1)
+        used += CHECKSUM_BYTES
+    pad = wire_cols(h, f) - used
+    img = jnp.concatenate(
+        [body, jnp.zeros((m, pad), jnp.int8)], axis=-1)
+    return _maybe_corrupt(img, h, sb.shape[1])
 
 
 def decode_rows(w: jax.Array, h: int, fmt: WireFormatLike,
@@ -227,6 +289,62 @@ def decode_rows(w: jax.Array, h: int, fmt: WireFormatLike,
         w[:, h:h + SCALE_BYTES * nb].reshape(m, nb, SCALE_BYTES),
         jnp.float32)
     return dequantize(q, s, f, out_dtype)
+
+
+def verify_rows(w: jax.Array, h: int, fmt: WireFormatLike) -> jax.Array:
+    """Per-row integrity verdict of a checksummed wire image: True
+    where the recomputed byte-sum over payload + scale columns matches
+    the embedded checksum word. Pure jnp — usable at host level AND
+    inside Pallas kernel bodies (the consume edge; pair with
+    faults.guard.integrity_trip to turn a failure into a guard row)."""
+    f = resolve(fmt)
+    if not f.checksum:
+        raise ValueError(
+            f"wire format {f} carries no checksum (WireFormat("
+            "checksum=True))")
+    nb = n_blocks(h, f)
+    used = h + SCALE_BYTES * nb
+    got = _row_checksum(w[:, :used])
+    want = jax.lax.bitcast_convert_type(
+        w[:, used:used + CHECKSUM_BYTES].reshape(
+            w.shape[0], 1, CHECKSUM_BYTES), jnp.int32)[:, 0]
+    return got == want
+
+
+def _eager_integrity_check(w, h: int, f: WireFormat) -> None:
+    """Detect-and-raise consume edge for CONCRETE wire images: raises
+    WireIntegrityError naming the corrupted rows. Traced values skip
+    (a jit program cannot raise; in-jit consumers pair verify_rows
+    with a host-side check or a guard row instead)."""
+    import jax.core as jcore
+
+    if isinstance(w, jcore.Tracer):
+        return
+    import numpy as np
+
+    from triton_dist_tpu.faults.errors import WireIntegrityError
+
+    ok = np.asarray(verify_rows(w, h, f))
+    if not ok.all():
+        bad = np.nonzero(~ok)[0].tolist()
+        raise WireIntegrityError(
+            f"wire image failed its checksum on {len(bad)} row(s) "
+            f"{bad[:8]} (payload or scale stripe corrupted in flight)",
+            rows=bad)
+
+
+def unpack_checked(w: jax.Array, trailing_shape, fmt: WireFormatLike,
+                   out_dtype) -> jax.Array:
+    """`unpack` with a MANDATORY integrity check (checksum formats
+    only): the consume edge that raises WireIntegrityError on a
+    corrupted image rather than dequantizing garbage."""
+    f = resolve(fmt)
+    if f.kind == "native":
+        raise ValueError("native wire has no checksum to check")
+    import math as _math
+
+    _eager_integrity_check(w, _math.prod(trailing_shape), f)
+    return unpack(w, trailing_shape, f, out_dtype)
 
 
 def pack(x: jax.Array, fmt: WireFormatLike) -> jax.Array:
@@ -250,6 +368,10 @@ def unpack(w: jax.Array, trailing_shape, fmt: WireFormatLike,
     if f.kind == "native":
         return w
     h = math.prod(trailing_shape)
+    if f.checksum:
+        # detect-and-raise on concrete images; traced ones defer to
+        # verify_rows at the caller's consume edge (see unpack_checked)
+        _eager_integrity_check(w, h, f)
     out = decode_rows(w, h, f, out_dtype)
     return out.reshape((w.shape[0],) + tuple(trailing_shape))
 
