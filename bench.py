@@ -51,6 +51,12 @@ guard plane — `faults_overhead_frac` (guarded/plain ag_gemm chain
 time - 1) HARD-ASSERTED < 0.03, plus `faults_guard_trips` (the clean
 chain's watchdog-trip audit, asserted 0: a guard that trips without a
 fault is as broken as one that never trips).
+
+`--obs` (opt-in; see docs/observability.md): the same gate for the
+always-on stat-row tier — `obs_overhead_frac` (metered/plain ag_gemm
+chain time - 1) HARD-ASSERTED < 0.03, plus `obs_stat_events` (the
+metered run's decoded event total, asserted > 0: a meter that records
+nothing is as broken as one that taxes the kernel).
 """
 
 import json
@@ -1001,6 +1007,7 @@ def bench_serving(mesh, qps_levels=(1.0, 4.0), n_requests=10,
 
 TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
 FAULTS_OVERHEAD_CEIL = 0.03  # hard guard on --faults watchdog cost
+OBS_OVERHEAD_CEIL = 0.03    # hard guard on --obs stat-row metering cost
 
 
 def _ag_overhead_chain(mesh, cfg, strip_trailing, out_cols=None):
@@ -1084,6 +1091,55 @@ def bench_faults_overhead(mesh, x, w1, k_hi=41, pairs=7,
         f"{ceil} ceiling on the ag_gemm arm "
         f"({g_ms:.4f} vs {ms:.4f} ms)")
     return frac, g_ms, ms, len(trips)
+
+
+def bench_obs_overhead(mesh, x, w1, k_hi=41, pairs=7, out_cols=None,
+                       ceil=None):
+    """Stat-row metering overhead on the forced ag_gemm kernel arm (the
+    --trace/--faults gates mirrored for the always-on tier): the
+    identical chain timed with and without an active obs.stats build.
+    Returns (overhead_frac, metered_ms, plain_ms, n_events);
+    overhead_frac is hard-asserted < OBS_OVERHEAD_CEIL and the metered
+    run's stat rows must decode with a NONZERO event count — a meter
+    that records nothing has silently detached from the kernel it
+    claims to observe. (Zero-cost when OFF is the separate bit-identity
+    contract tests/test_obs.py pins.)"""
+    from triton_dist_tpu.obs import stats as _ost
+
+    cfg = AgGemmConfig(256, 3200, 512)
+    chain = lambda metered: _ag_overhead_chain(  # noqa: E731
+        mesh, cfg, strip_trailing=metered, out_cols=out_cols)
+
+    ms, _ = _chain_timer(chain(False), (x, w1), k_hi=k_hi, pairs=pairs)
+    with _ost.building():
+        m_ms, _ = _chain_timer(chain(True), (x, w1), k_hi=k_hi,
+                               pairs=pairs)
+        # one non-chained metered run for the stat audit (the chain
+        # drops the rows inside fori_loop on purpose)
+        fn = jax.jit(jax.shard_map(
+            lambda x, w: ag_gemm(x, w, axis="tp", config=cfg,
+                                 force_kernel=True, c_order="arrival"),
+            mesh=mesh, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=(P(None, "tp"), P("tp")),
+            check_vma=False))
+        _c, orow = jax.block_until_ready(fn(x, w1))
+    import numpy as _np
+
+    world = mesh.devices.size
+    tot = _ost.totals(_np.asarray(orow).reshape(world, 1,
+                                                _ost.STAT_WORDS))
+    assert tot.events > 0, (
+        "metered ag_gemm recorded zero events — the stat-row meter has "
+        "silently detached from the kernel")
+    frac = m_ms / ms - 1.0
+    # `ceil` is overridable ONLY for the tiny-shape test smoke (see
+    # bench_faults_overhead); the driver path runs the production gate
+    ceil = OBS_OVERHEAD_CEIL if ceil is None else ceil
+    assert frac < ceil, (
+        f"stat-row metering overhead {frac:.4f} exceeds the "
+        f"{ceil} ceiling on the ag_gemm arm "
+        f"({m_ms:.4f} vs {ms:.4f} ms)")
+    return frac, m_ms, ms, tot.events
 
 
 def bench_trace_overhead(mesh, x, w1, k_hi=41, pairs=7):
@@ -1173,7 +1229,8 @@ _STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
 # signed numerics: legitimately negative (an overhead measurement can
 # read slightly below zero in chain-timer noise) — exempt from the
 # `v < 0` malformed-value rule, never from finiteness
-_SIGNED_KEYS = {"overhead_frac", "faults_overhead_frac"}
+_SIGNED_KEYS = {"overhead_frac", "faults_overhead_frac",
+                "obs_overhead_frac"}
 _NUMERIC_KEYS = {
     "value", "vs_baseline",
     "mega_8b_hbm_floor_ms", "mega_8b_gap_vs_floor",
@@ -1218,10 +1275,18 @@ _NUMERIC_KEYS = {
     # chain's trip audit (must be 0 — a guard that trips without a
     # fault is broken)
     "faults_overhead_frac", "faults_guard_trips",
+    # always-on telemetry (ISSUE 11): stat-row metering overhead on the
+    # ag_gemm arm (--obs; mirror of --trace/--faults) + the metered
+    # run's decoded event audit (must be > 0 — a meter recording
+    # nothing is broken)
+    "obs_overhead_frac", "obs_stat_events",
 }
 # the --faults keys travel together (an overhead claim without its trip
 # audit — or vice versa — is unfalsifiable from the artifact)
 _FAULTS_KEYS = {"faults_overhead_frac", "faults_guard_trips"}
+# the --obs keys likewise (an overhead claim without the event audit
+# could hide a meter that compiles to nothing)
+_OBS_KEYS = {"obs_overhead_frac", "obs_stat_events"}
 # the SP-prefill keys travel together: a round that emits any of them
 # must emit them all plus the tail-stat raw dict — a ratio without its
 # absolute arms (or vice versa) is unfalsifiable from the artifact
@@ -1320,6 +1385,16 @@ def check_result(result: dict) -> list:
                 "allreduce_wire_model_pick must ride beside the "
                 "allreduce_wire_* keys (the selector's choice is part "
                 "of the artifact)")
+    obs_present = _OBS_KEYS & set(result)
+    if obs_present:
+        for k in _OBS_KEYS - set(result):
+            problems.append(
+                f"obs keys travel together: {k!r} missing while "
+                f"{sorted(obs_present)[0]!r} is present")
+        if result.get("obs_stat_events", 1) <= 0:
+            problems.append(
+                "obs_stat_events must be > 0 on the metered bench "
+                "chain (a meter recording nothing is broken)")
     flt_present = _FAULTS_KEYS & set(result)
     if flt_present:
         for k in _FAULTS_KEYS - set(result):
@@ -1535,6 +1610,25 @@ def main():
         result["faults_guard_trips"] = ntrips
         print(f"bench.py --faults: faults_overhead_frac={ffrac:.4f} "
               f"({g_ms:.4f} vs {un_ms:.4f} ms), trips={ntrips}",
+              file=sys.stderr)
+
+    if "--obs" in sys.argv:
+        # opt-in always-on-telemetry smoke arm (never on the driver's
+        # default path): the stat-row metering overhead gate on the
+        # ag_gemm chain, mirror of the --trace/--faults gates. HARD
+        # failures by design — metering that taxes the kernels > 3%
+        # when on, or records nothing, must not ship.
+        rng = np.random.default_rng(0)
+        xo = jnp.asarray(
+            rng.standard_normal((M, HIDDEN)) * 0.02, jnp.bfloat16)
+        w1o = jnp.asarray(
+            rng.standard_normal((HIDDEN, N_GATE_UP * world)) * 0.02,
+            jnp.bfloat16)
+        ofrac, o_ms, p_ms, nev = bench_obs_overhead(mesh, xo, w1o)
+        result["obs_overhead_frac"] = round(ofrac, 4)
+        result["obs_stat_events"] = nev
+        print(f"bench.py --obs: obs_overhead_frac={ofrac:.4f} "
+              f"({o_ms:.4f} vs {p_ms:.4f} ms), events={nev}",
               file=sys.stderr)
 
     if "--trace" in sys.argv:

@@ -14,6 +14,12 @@ server streams {"tok": t} per generated token, then {"gen": [[...]]}.
 Errors keep the envelope contract: one {"error": ...} line, so the
 client never hangs on a server fault.
 
+Observability (docs/observability.md): the literal line `/metrics`
+(or {"op": "metrics"}) answers with the scheduler registry's
+Prometheus text exposition and closes — a scrape endpoint riding the
+same socket, serving the TTFT/TPOT histograms, queue/pool gauges, and
+policy counters the scheduler streams while it batches.
+
 Run:  python examples/11_model_server.py [--tpu]
 """
 
@@ -48,9 +54,22 @@ def serve(sock, sch):
             if not line:
                 return
             try:
+                if line.strip() == "/metrics":
+                    # scrape endpoint: Prometheus text, then close
+                    from triton_dist_tpu import obs
+
+                    f.write(obs.to_prometheus(sch.obs))
+                    f.flush()
+                    return
                 req = json.loads(line)
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
+                if req.get("op") == "metrics":
+                    from triton_dist_tpu import obs
+
+                    f.write(obs.to_prometheus(sch.obs))
+                    f.flush()
+                    return
                 if req.get("op") == "stop":
                     stop_evt.set()
                     sock.close()  # unblocks the accept loop
@@ -135,6 +154,22 @@ def main():
     assert sch.worker.n_steps < 14, (
         f"requests were served serially ({sch.worker.n_steps} steps)"
     )
+
+    # the /metrics scrape endpoint: the served traffic above must be
+    # visible in the registry exposition (docs/observability.md)
+    c = socket.create_connection(("localhost", port))
+    with c:
+        f = c.makefile("rw")
+        f.write("/metrics\n")
+        f.flush()
+        text = f.read()
+    assert "serve_tokens_out_total" in text and \
+        "serve_ttft_us_count" in text, text[:400]
+    n_tok = [ln for ln in text.splitlines()
+             if ln.startswith("serve_tokens_out_total")]
+    assert n_tok and int(n_tok[0].split()[-1]) == 2 * GEN, n_tok
+    print("11 model server: /metrics scrape served "
+          f"{len(text.splitlines())} exposition lines")
 
     # bad request exercises the error envelope
     c = socket.create_connection(("localhost", port))

@@ -1,12 +1,15 @@
 #!/usr/bin/env python
 """trace_report — per-region attribution + predicted-stall diff from an
-exported trace JSON.
+exported trace JSON, and (--metrics) registry-snapshot / flight-dump
+rendering for the always-on tier.
 
 Usage:
     python scripts/trace_report.py TRACE.json [TRACE2.json ...]
+    python scripts/trace_report.py --metrics SNAP_OR_DUMP.json [...]
 
-Reads Perfetto/Chrome-trace JSONs written by `trace.write_trace`
-(examples/12_trace_overlap.py, `bench.py --trace`), prints:
+Default mode reads Perfetto/Chrome-trace JSONs written by
+`trace.write_trace` (examples/12_trace_overlap.py, `bench.py --trace`),
+and prints:
 
   * per-stream attribution: compute / sem_wait / dma_wait fractions of
     the traced span time (from the events' `cat` classification);
@@ -15,10 +18,17 @@ Reads Perfetto/Chrome-trace JSONs written by `trace.write_trace`
     compare_predicted` report (otherData["compare_predicted"]), the
     measured-vs-predicted scoreboard-stall diff per (rank, queue).
 
-Exits non-zero on a malformed trace (missing magic format tag, events
-without ph/pid/ts) — the same strictness contract as bench.check_result:
-a tool that silently renders a clobbered trace would hide exactly the
-bugs the trace exists to catch.
+`--metrics` mode reads the always-on tier's artifacts — a metrics
+registry snapshot (`obs.write_snapshot`, magic "tdt-metrics") or a
+flight-recorder dump (`FlightRecorder.dump`, magic "tdt-flight") — and
+renders them in the same table style: counters/gauges/histogram
+quantiles for a snapshot; the per-step ring (metric deltas, scheduler
+state, decoded guard rows) for a dump.
+
+Exits non-zero on a malformed input in BOTH modes (missing magic tag,
+torn histograms, dump snapshots without their guard-row lists) — the
+bench.check_result strictness contract: a tool that silently renders a
+clobbered artifact would hide exactly the bugs it exists to catch.
 """
 
 from __future__ import annotations
@@ -104,15 +114,92 @@ def report(path: str) -> None:
     print()
 
 
+def _metrics_table(snap: dict, indent: str = "") -> None:
+    """Counters / gauges / histogram quantiles of one snapshot dict."""
+    for key in sorted(snap.get("counters", {})):
+        print(f"{indent}{key:<44} {snap['counters'][key]:>12}")
+    for key in sorted(snap.get("gauges", {})):
+        print(f"{indent}{key:<44} {snap['gauges'][key]:>12.4g}")
+    hists = snap.get("histograms", {})
+    if hists:
+        print(f"{indent}{'histogram':<32} {'count':>8} {'p50':>10} "
+              f"{'p99':>10} {'max':>10}")
+    for key in sorted(hists):
+        from triton_dist_tpu.obs.registry import Histogram
+
+        h = Histogram.from_state(hists[key])
+        print(f"{indent}{key:<32} {h.total:>8} {h.quantile(0.5):>10.1f} "
+              f"{h.quantile(0.99):>10.1f} "
+              f"{0.0 if h.total == 0 else h.max:>10.1f}")
+
+
+def report_metrics(path: str) -> None:
+    """Render one always-on-tier artifact: a registry snapshot or a
+    flight-recorder dump (dispatch on the magic tag). ValueError on
+    malformed input -> exit 1 in main."""
+    import json
+
+    from triton_dist_tpu.obs.recorder import FLIGHT_MAGIC, check_dump
+    from triton_dist_tpu.obs.registry import SNAPSHOT_MAGIC, Registry
+
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not JSON: {e}") from e
+    magic = doc.get("magic") if isinstance(doc, dict) else None
+    if magic == SNAPSHOT_MAGIC:
+        Registry.check_snapshot(doc)
+        print(f"== {path} (metrics snapshot) ==")
+        _metrics_table(doc)
+    elif magic == FLIGHT_MAGIC:
+        check_dump(doc)
+        snaps = doc["snapshots"]
+        print(f"== {path} (flight recorder: {len(snaps)} snapshots, "
+              f"reason: {doc.get('reason', '?')}) ==")
+        for s in snaps:
+            sched = s.get("scheduler", {})
+            head = (f"step {s['step']:>5}  active={len(sched.get('active', {}))} "
+                    f"queue={sched.get('queue_depth', '?')} "
+                    f"retries={sched.get('step_retries', '?')}")
+            if s.get("error"):
+                head += f"  ERROR: {s['error'][:80]}"
+            print(head)
+            delta = s.get("metrics_delta") or {}
+            for key in sorted(delta.get("counters", {})):
+                print(f"    +{key:<42} {delta['counters'][key]:>8}")
+            for r in s["guard_rows"]:
+                print(f"    guard row: rank {r['rank']} "
+                      f"{r.get('site_label', r['site'])} slot={r['slot']} "
+                      f"expected>={r['expected']} observed={r['observed']}")
+    else:
+        raise ValueError(
+            f"{path}: magic {magic!r} is neither a metrics snapshot "
+            f"({SNAPSHOT_MAGIC!r}) nor a flight dump ({FLIGHT_MAGIC!r})")
+    print()
+
+
 def main(argv) -> int:
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
+    metrics_mode = "--metrics" in argv
+    paths = [a for a in argv if a != "--metrics"]
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
     try:
-        for path in argv:
-            report(path)
+        for path in paths:
+            if metrics_mode:
+                report_metrics(path)
+            else:
+                report(path)
     except MalformedTrace as e:
         print(f"trace_report: malformed trace: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"trace_report: malformed metrics artifact: {e}",
+              file=sys.stderr)
         return 1
     return 0
 

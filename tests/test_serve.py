@@ -617,3 +617,92 @@ def test_evict_requeue_ordering_under_stalled_step(eng1, prompts):
     assert toks == _sequential(eng1, prompts[:2], 14)
     sch.pool.check()
     del total
+
+
+# ---------- Scheduler.metrics() key schema (ISSUE 11 satellite) ----------
+
+# the metrics() contract: these keys travel together on EVERY read —
+# a dashboard keyed on one of them must never silently lose another
+# (docs/observability.md "Serve metrics")
+_METRICS_BASE_KEYS = {
+    "n", "tokens_per_s", "quarantined", "step_retries",
+    "submitted", "rejected", "admitted", "evicted", "preempted",
+    "retries", "guard_trips", "steps", "tokens_out",
+    "queue_depth", "active_slots", "pool_free_pages", "pool_used_pages",
+}
+_METRICS_LATENCY_KEYS = {"ttft_p50_us", "ttft_p99_us",
+                         "tpot_p50_us", "tpot_p99_us"}
+_METRICS_COUNTER_KEYS = (
+    "submitted", "rejected", "admitted", "evicted", "preempted",
+    "retries", "guard_trips", "steps", "tokens_out", "quarantined",
+    "step_retries",
+)
+
+
+def test_metrics_keys_travel_together(eng1, prompts):
+    sch = Scheduler(eng1, **GEO)
+    m0 = sch.metrics()
+    assert _METRICS_BASE_KEYS <= set(m0), (
+        _METRICS_BASE_KEYS - set(m0))
+    for r in prompts:
+        sch.submit(r, max_new_tokens=4)
+    sch.run()
+    m1 = sch.metrics()
+    # the full schema including the latency summary once requests
+    # finished; every counter is an int, every gauge-like key >= 0
+    assert (_METRICS_BASE_KEYS | _METRICS_LATENCY_KEYS) <= set(m1), (
+        (_METRICS_BASE_KEYS | _METRICS_LATENCY_KEYS) - set(m1))
+    for k in _METRICS_COUNTER_KEYS:
+        assert isinstance(m1[k], int) and m1[k] >= 0, (k, m1[k])
+    assert m1["n"] == len(prompts) and m1["admitted"] == len(prompts)
+    assert m1["tokens_out"] == 4 * len(prompts)
+    assert m1["ttft_p99_us"] >= m1["ttft_p50_us"] > 0
+
+
+def test_metrics_counters_monotone_across_steps(eng1, prompts):
+    sch = Scheduler(eng1, **GEO)
+    for r in prompts:
+        sch.submit(r, max_new_tokens=5)
+    prev = sch.metrics()
+    for _ in range(200):
+        progressed = sch.step()
+        cur = sch.metrics()
+        for k in _METRICS_COUNTER_KEYS:
+            assert cur[k] >= prev[k], (
+                f"counter {k!r} moved backwards: {prev[k]} -> {cur[k]}")
+        prev = cur
+        if not progressed and sch.queue.peek() is None:
+            break
+    assert prev["steps"] > 0 and prev["tokens_out"] == 5 * len(prompts)
+
+
+def test_metrics_match_injected_failstep_plan(eng1, prompts):
+    """Quarantine/retry counts must equal what the injected FailStep
+    plan implies: times == retry budget + 1 consumes exactly one
+    quarantine after exactly max_step_retries retries, and the trip
+    counter mirrors every failed attempt."""
+    from triton_dist_tpu import faults
+
+    sch = Scheduler(eng1, **GEO, max_step_retries=2)
+    plan = faults.FaultPlan(faults.FailStep(at_step=1, times=3))
+    with faults.injecting(plan):
+        for r in prompts[:2]:
+            sch.submit(r, max_new_tokens=4)
+        sch.run()
+    m = sch.metrics()
+    assert m["step_retries"] == 3  # 1 first try + 2 retries, all failed
+    assert m["retries"] == 3
+    assert m["quarantined"] == 1
+    assert m["guard_trips"] == 3  # one DeadlineExceeded per attempt
+    # survivors finished; the registry histogram streamed their TTFT
+    assert sch.obs.hist_count("serve_ttft_us") == m["n"] >= 1
+    # and a transient fault (fewer times than the budget) quarantines
+    # nothing while still counting its retries
+    sch2 = Scheduler(eng1, **GEO, max_step_retries=2)
+    with faults.injecting(faults.FaultPlan(
+            faults.FailStep(at_step=1, times=1))):
+        sch2.submit(prompts[0], max_new_tokens=4)
+        sch2.run()
+    m2 = sch2.metrics()
+    assert m2["quarantined"] == 0 and m2["step_retries"] == 1
+    assert m2["n"] == 1

@@ -19,6 +19,9 @@ Layer map (mirrors reference SURVEY.md table; reference = Triton-distributed):
   trace/     - in-kernel event tracing, stall attribution, Perfetto
                export (ref: the intra-kernel profiler hooks;
                docs/observability.md)
+  obs/       - always-on telemetry: metrics registry, O(1) in-kernel
+               stat rows, flight recorder, SLO health, exporters
+               (docs/observability.md)
 Subpackages under construction land here as they are built (layers/,
 models/, megakernel/, tools/, csrc/ in the reference's inventory).
 """

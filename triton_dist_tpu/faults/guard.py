@@ -199,15 +199,16 @@ class GuardCtx:
     poll_ns: int = 10_000
     rank: Any = 0
     tctx: Any = None
+    octx: Any = None  # obs/stats.MeterCtx: trips land in the stat row
 
 
 def make_ctx(build: Optional[GuardBuild], buf_ref, cur_ref, rank=0,
-             tctx=None) -> Optional[GuardCtx]:
+             tctx=None, octx=None) -> Optional[GuardCtx]:
     if build is None:
         return None
     return GuardCtx(buf=buf_ref, cur=cur_ref, cap=build.cap,
                     deadline=build.deadline, poll_ns=build.poll_ns,
-                    rank=rank, tctx=tctx)
+                    rank=rank, tctx=tctx, octx=octx)
 
 
 def init_ctx(ctx: Optional[GuardCtx], rank=0) -> None:
@@ -294,6 +295,17 @@ def _trip_store(ctx: GuardCtx, site: int, slot, expected, observed):
 
         trace_ev.instant(ctx.tctx, trace_ev.REGIONS["guard.trip"],
                          payload=site, aux=slot)
+    # coexisting obs build: the trip also lands in the O(1) stat row
+    # (explicitly wired octx, or the ambient meter of attached-style
+    # kernels). When the trace instant above fired too, mirror its tick
+    # so the meter clock stays in lockstep with the trace cursor.
+    from triton_dist_tpu.obs import stats as _obs_stats
+
+    octx = ctx.octx if ctx.octx is not None else _obs_stats.current()
+    if octx is not None:
+        octx.add_trip()
+        if ctx.tctx is not None:
+            octx.tick()
 
 
 # -- the watchdog -------------------------------------------------------------

@@ -39,6 +39,7 @@ from triton_dist_tpu.lang.core import (
     interpret_no_headroom,
 )
 from triton_dist_tpu.faults import guard as _guard
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.wire import codec as wcodec
 
@@ -67,7 +68,7 @@ def choose_allgather_method(nbytes_per_rank: int) -> AllGatherMethod:
     return AllGatherMethod.Ring1D
 
 
-def _ring_ag_kernel(axis: str, n: int, gbuild, *refs):
+def _ring_ag_kernel(axis: str, n: int, gbuild, obuild, fmtc, *refs):
     """1-D ring AG: step s sends chunk (me-s) mod n to the right neighbor
     (ref: allgather.py:140-194 ring push; same chunk rotation).
 
@@ -78,13 +79,15 @@ def _ring_ag_kernel(axis: str, n: int, gbuild, *refs):
     (the analog of the reference's per-chunk barrier words,
     allgather.py:106-138). Output slots are distinct per chunk, so no
     flow control is needed on the data buffers themselves."""
-    x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem = \
-        _ag_unpack(gbuild, refs)
+    (x_ref, o_ref, gbuf, gcur, obuf, ocur, local_sem, send_sem,
+     recv_sem) = _ag_unpack(gbuild, obuild, refs)
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0]
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
+    _obs.init_ctx(octx, rank=me, fmt=fmtc)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, octx=octx)
     _guard.init_ctx(gctx, rank=me)
-    with _guard.attached(gctx):
+    with _guard.attached(gctx), _obs.attached(octx):
         shmem.neighbor_barrier(axis, me, n)
         shmem.fault_delay(axis, "allgather")
 
@@ -110,39 +113,47 @@ def _ring_ag_kernel(axis: str, n: int, gbuild, *refs):
             h.wait_recv(slot=s)
 
 
-def _full_mesh_ag_kernel(axis: str, n: int, gbuild, *refs):
+def _full_mesh_ag_kernel(axis: str, n: int, gbuild, obuild, fmtc,
+                         *refs):
     """Full-mesh push AG: put the local shard directly into every peer's
     slot `me` (ref: allgather.py:81-138 cp_engine full-mesh push). The
     body is the device-side `fcollect` primitive."""
-    x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem = \
-        _ag_unpack(gbuild, refs)
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
-    _guard.init_ctx(gctx, rank=jax.lax.axis_index(axis))
-    with _guard.attached(gctx):
+    (x_ref, o_ref, gbuf, gcur, obuf, ocur, local_sem, send_sem,
+     recv_sem) = _ag_unpack(gbuild, obuild, refs)
+    me = jax.lax.axis_index(axis)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
+    _obs.init_ctx(octx, rank=me, fmt=fmtc)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, octx=octx)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx), _obs.attached(octx):
         shmem.barrier_all(axis)
         shmem.fault_delay(axis, "allgather")
         shmem.fcollect(o_ref, x_ref, local_sem, send_sem, recv_sem,
                        axis, n)
 
 
-def _ag_unpack(gbuild, refs):
-    """Outputs (o_ref + guard buffer) precede scratch; the guard cursor
-    is the trailing scratch entry."""
+def _ag_unpack(gbuild, obuild, refs):
+    """Outputs (o_ref + guard buffer + stat row) precede scratch; the
+    guard/obs cursors are the trailing scratch entries."""
     refs = list(refs)
     x_ref, o_ref = refs[0], refs[1]
     del refs[:2]
     gbuf = refs.pop(0) if gbuild is not None else None
+    obuf = refs.pop(0) if obuild is not None else None
+    ocur = refs.pop() if obuild is not None else None
     gcur = refs.pop() if gbuild is not None else None
     local_sem, send_sem, recv_sem = refs
-    return x_ref, o_ref, gbuf, gcur, local_sem, send_sem, recv_sem
+    return (x_ref, o_ref, gbuf, gcur, obuf, ocur, local_sem, send_sem,
+            recv_sem)
 
 
 def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
-               per_step_recv: bool) -> jax.Array:
+               per_step_recv: bool, fmtc: int = 0) -> jax.Array:
     n = jax.lax.axis_size(axis)
     if x.ndim < 2:
         raise ValueError(f"all_gather needs >=2D shards, got shape {x.shape}")
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
     out_shape = jax.ShapeDtypeStruct((n * x.shape[0],) + x.shape[1:], x.dtype)
     out_specs = pl.BlockSpec(memory_space=pl.ANY)
     recv = (
@@ -159,8 +170,14 @@ def _pallas_ag(x: jax.Array, axis: str, kernel_body, name: str,
         out_shape = (out_shape, _guard.out_shape(gbuild))
         out_specs = (out_specs, _guard.out_spec())
         scratch.append(_guard.cursor_scratch())
+    if obuild is not None:
+        out_shape = (out_shape if isinstance(out_shape, tuple)
+                     else (out_shape,)) + (_obs.out_shape(obuild),)
+        out_specs = (out_specs if isinstance(out_specs, tuple)
+                     else (out_specs,)) + (_obs.out_spec(),)
+        scratch.append(_obs.cursor_scratch())
     return tpu_call(
-        functools.partial(kernel_body, axis, n, gbuild),
+        functools.partial(kernel_body, axis, n, gbuild, obuild, fmtc),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=out_specs,
@@ -185,17 +202,27 @@ def _wire_ag(x: jax.Array, axis: str, fmt, transport,
     composition, which the tests pin)."""
     n = jax.lax.axis_size(axis)
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
     w = wcodec.pack(x, fmt)
-    gbuf = None
+    gbuf = obuf = None
     if n == 1 and not force_kernel:
         gathered = w
     elif interpret_no_headroom():
         gathered = jax.lax.all_gather(w, axis, tiled=True)
     else:
         res = transport(w)
-        gathered, gbuf = (res if gbuild is not None else (res, None))
-    return _guard.with_guard(
-        gbuild, wcodec.unpack(gathered, x.shape[1:], fmt, x.dtype), gbuf)
+        res = res if isinstance(res, tuple) else (res,)
+        gathered = res[0]
+        gbuf = res[1] if gbuild is not None else None
+        obuf = res[-1] if obuild is not None else None
+    if obuild is not None and obuf is None:
+        obuf = _obs.new_stream(obuild, fmt=_obs.fmt_code(fmt))
+    return _obs.with_stats(
+        obuild,
+        _guard.with_guard(
+            gbuild, wcodec.unpack(gathered, x.shape[1:], fmt, x.dtype),
+            gbuf),
+        obuf)
 
 
 def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
@@ -210,17 +237,19 @@ def ring_all_gather(x: jax.Array, axis: str = TP_AXIS, wire_format=None,
     cost)."""
     fmt = wcodec.resolve(wire_format)
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
     if not wcodec.is_native(fmt):
         return _wire_ag(
             x, axis, fmt,
             lambda w: _pallas_ag(w, axis, _ring_ag_kernel,
-                                 f"ring_ag_{axis}", per_step_recv=True),
+                                 f"ring_ag_{axis}", per_step_recv=True,
+                                 fmtc=_obs.fmt_code(fmt)),
             force_kernel)
     if jax.lax.axis_size(axis) == 1 and not force_kernel:
-        return _guard.with_guard(gbuild, x)
+        return _obs.with_stats(obuild, _guard.with_guard(gbuild, x))
     if interpret_no_headroom():
-        return _guard.with_guard(
-            gbuild, jax.lax.all_gather(x, axis, tiled=True))
+        return _obs.with_stats(obuild, _guard.with_guard(
+            gbuild, jax.lax.all_gather(x, axis, tiled=True)))
     return _pallas_ag(x, axis, _ring_ag_kernel, f"ring_ag_{axis}",
                       per_step_recv=True)
 
@@ -233,17 +262,19 @@ def full_mesh_all_gather(x: jax.Array, axis: str = TP_AXIS,
     ring_all_gather (the push moves the wire image)."""
     fmt = wcodec.resolve(wire_format)
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
     if not wcodec.is_native(fmt):
         return _wire_ag(
             x, axis, fmt,
             lambda w: _pallas_ag(w, axis, _full_mesh_ag_kernel,
-                                 f"fm_ag_{axis}", per_step_recv=False),
+                                 f"fm_ag_{axis}", per_step_recv=False,
+                                 fmtc=_obs.fmt_code(fmt)),
             force_kernel=False)
     if jax.lax.axis_size(axis) == 1:
-        return _guard.with_guard(gbuild, x)
+        return _obs.with_stats(obuild, _guard.with_guard(gbuild, x))
     if interpret_no_headroom():
-        return _guard.with_guard(
-            gbuild, jax.lax.all_gather(x, axis, tiled=True))
+        return _obs.with_stats(obuild, _guard.with_guard(
+            gbuild, jax.lax.all_gather(x, axis, tiled=True)))
     return _pallas_ag(x, axis, _full_mesh_ag_kernel, f"fm_ag_{axis}",
                       per_step_recv=False)
 
@@ -293,11 +324,11 @@ def all_gather(
                 x.shape[1:], wire_format, x.dtype)
         return jax.lax.all_gather(x, axis, tiled=True)
     if method == AllGatherMethod.Ring1D:
-        return _guard.primary(
-            ring_all_gather(x, axis, wire_format=wire_format))
+        return _guard.primary(_obs.primary(
+            ring_all_gather(x, axis, wire_format=wire_format)))
     if method == AllGatherMethod.FullMesh:
-        return _guard.primary(
-            full_mesh_all_gather(x, axis, wire_format=wire_format))
+        return _guard.primary(_obs.primary(
+            full_mesh_all_gather(x, axis, wire_format=wire_format)))
     raise ValueError(f"unknown method {method}")
 
 

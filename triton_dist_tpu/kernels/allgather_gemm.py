@@ -71,6 +71,7 @@ from triton_dist_tpu.lang.core import (
     cdiv,
     interpret_no_headroom,
 )
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.trace import events as trace_ev
 from triton_dist_tpu.wire import codec as wcodec
@@ -115,7 +116,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     tm: int, tn: int, tk: int, out_dtype, straggler,
                     need_ws: bool, cache_a: bool, silu_pair: bool,
                     arrival: bool, grouped: bool, wire, build, gbuild,
-                    *refs):
+                    obuild, *refs):
     # `wire`: None for the native payload, else (fmt, k) — the A shard /
     # ring workspace hold the block-scaled int8 wire image (payload
     # columns [0, k), per-row f32 scales bitcast at [k, k+4)); the ring
@@ -130,6 +131,8 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     del refs[:2]
     tbuf = refs.pop(0) if build is not None else None
     gbuf = refs.pop(0) if gbuild is not None else None
+    obuf = refs.pop(0) if obuild is not None else None
+    ocur = refs.pop() if obuild is not None else None
     gcur = refs.pop() if gbuild is not None else None
     a_buf = refs.pop(0)
     scale_buf = refs.pop(0) if wire is not None else None
@@ -152,13 +155,14 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     else:
         ld_sems, st_sem, cp_sem, send_sem, recv_sems = refs
     tctx = trace_ev.make_ctx(build, tbuf, tcur)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
     R = trace_ev.REGIONS
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
     kk = pl.program_id(3)
     me = jax.lax.axis_index(axis)
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur, tctx=tctx)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, tctx=tctx, octx=octx)
     m_loc = a_ref.shape[0]
     chunk = jnp.mod(me - s, n)
     right = jnp.mod(me + 1, n)
@@ -240,9 +244,18 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 scale_buf, sc_sem,
             ).wait()
 
+    # what one ring forward actually puts on the wire, per step (wire
+    # legs move the int8 image: kw columns x 1 byte)
+    ws_send_bytes = m_loc * ws_ref.shape[1] \
+        * jnp.dtype(ws_ref.dtype).itemsize
+
+    def meter_fwd():
+        if octx is not None:
+            octx.add_bytes(ws_send_bytes)
+
     def a_wait(slot):
         # descriptor only carries the byte count for the semaphore wait
-        with trace_ev.span(tctx, R["ag.a_wait"], payload=flat, aux=s):
+        with _obs.span(tctx, octx, R["ag.a_wait"], payload=flat, aux=s):
             pltpu.make_async_copy(
                 ws_ref.at[pl.ds(0, tm), pl.ds(0, tk)], a_buf.at[slot],
                 ld_sems.at[slot],
@@ -260,13 +273,16 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             raw = jax.lax.bitcast_convert_type(raw, jnp.float8_e4m3fn)
         return (raw.astype(jnp.float32) * sc[:, None]).astype(a_dtype)
 
-    # trace init: the first grid step, before any emit below
+    # trace + obs init: the first grid step, before any emit below (the
+    # meter must be zeroed before the straggle instant can tick it)
     @pl.when(jnp.logical_and(flat == 0, s == 0))
     def _trace_init():
         trace_ev.init_ctx(tctx, rank=me)
+        _obs.init_ctx(octx, rank=me,
+                      fmt=_obs.fmt_code(wire[0] if wire else None))
         if straggler[1] > 0:
-            trace_ev.instant(
-                tctx, R["straggle"],
+            _obs.instant(
+                tctx, octx, R["straggle"],
                 payload=jnp.where(me == straggler[0], straggler[1], 0))
 
     if gctx is not None:
@@ -289,6 +305,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 # single-tile grids have no later slot to defer to
                 local_copy().wait()
                 fwd_copy(me, 0).start()
+                meter_fwd()
 
         if n > 1 and total > 1:
             # the forward start needs the local copy done, but the
@@ -298,6 +315,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             def _start_ring():
                 local_copy().wait()
                 fwd_copy(me, 0).start()
+                meter_fwd()
 
         if n == 1:
             # gathered-output-only copy: drain before kernel exit
@@ -310,7 +328,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         def _later_steps():
             prev_chunk = jnp.mod(me - s + 1, n)
             prev = fwd_copy(prev_chunk, s - 1)
-            with trace_ev.span(tctx, R["ag.ring_wait"], payload=s):
+            with _obs.span(tctx, octx, R["ag.ring_wait"], payload=s):
                 prev.wait_send()
                 # consumer wait: this step's A rows have landed
                 # (the dl.wait/consume_token contract, ref :236-237).
@@ -333,6 +351,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             @pl.when(s < n - 1)
             def _():
                 fwd_copy(chunk, s).start()
+                meter_fwd()
 
     # --- A-block staging.
     if cache_a:
@@ -401,7 +420,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
     # --- store the finished output tile.
     @pl.when(kk == nk - 1)
     def _store():
-        trace_ev.instant(tctx, R["ag.tile"], payload=flat, aux=s)
+        _obs.instant(tctx, octx, R["ag.tile"], payload=flat, aux=s)
         g = contrib if nk == 1 else acc[...]
         if silu_pair:
             u = contrib2 if nk == 1 else acc2[...]
@@ -489,13 +508,15 @@ def ag_gemm(
     cfg = config or AgGemmConfig()
     build = trace_ev.active_build()
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
 
     def with_trace(res, tbuf=None):
         return trace_ev.with_trace(build, res, tbuf)
 
     def with_fallback(res):
-        # fallback paths owe both trailing buffers (empty streams)
-        return _guard.with_guard(gbuild, with_trace(res))
+        # fallback paths owe every trailing buffer (empty streams)
+        return _obs.with_stats(
+            obuild, _guard.with_guard(gbuild, with_trace(res)))
     out_dtype = out_dtype or a_shard.dtype
     silu_pair = epilogue == "silu_pair"
     assert epilogue in (None, "silu_pair"), f"unknown epilogue {epilogue}"
@@ -702,6 +723,10 @@ def ag_gemm(
         out_shape += (_guard.out_shape(gbuild),)
         out_specs += (_guard.out_spec(),)
         scratch.append(_guard.cursor_scratch())
+    if obuild is not None:
+        out_shape += (_obs.out_shape(obuild),)
+        out_specs += (_obs.out_spec(),)
+        scratch.append(_obs.cursor_scratch())
     straggler = _fplan.scheduled_straggler("allgather_gemm") \
         or (cfg.straggler_rank, cfg.straggler_ns)
     res = tpu_call(
@@ -709,7 +734,7 @@ def ag_gemm(
                           tm, tn, tk, out_dtype, straggler,
                           need_ws, cache_a, silu_pair, arrival, grouped,
                           (fmt, k, a_shard.dtype) if wire else None,
-                          build, gbuild),
+                          build, gbuild, obuild),
         grid=grid,
         out_shape=out_shape,
         in_specs=in_specs,
@@ -748,9 +773,14 @@ def ag_gemm(
     tbuf = res[k_res] if build is not None else None
     k_res += 1 if build is not None else 0
     gbuf = res[k_res] if gbuild is not None else None
-    return _guard.with_guard(
-        gbuild, with_trace((c, ws) if return_gathered else c, tbuf),
-        gbuf)
+    k_res += 1 if gbuild is not None else 0
+    obuf = res[k_res] if obuild is not None else None
+    return _obs.with_stats(
+        obuild,
+        _guard.with_guard(
+            gbuild, with_trace((c, ws) if return_gathered else c, tbuf),
+            gbuf),
+        obuf)
 
 
 def ag_gemm_ref(a_shard: jax.Array, b: jax.Array, axis: str = TP_AXIS):

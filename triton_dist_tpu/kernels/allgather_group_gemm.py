@@ -45,8 +45,9 @@ def moe_all_gather(x_shard: jax.Array, axis: str = TP_AXIS) -> jax.Array:
     if n == 1 or interpret_no_headroom():
         return jax.lax.all_gather(x_shard, axis, tiled=True)
     from triton_dist_tpu.faults import guard as _guard
+    from triton_dist_tpu.obs import stats as _obs
 
-    return _guard.primary(ring_all_gather(x_shard, axis))
+    return _guard.primary(_obs.primary(ring_all_gather(x_shard, axis)))
 
 
 def ag_group_gemm(

@@ -36,6 +36,7 @@ from triton_dist_tpu.lang.core import (
 )
 from triton_dist_tpu.kernels.allgather import ring_all_gather
 from triton_dist_tpu.kernels.reduce_scatter import ring_reduce_scatter
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.wire import codec as wcodec
 
@@ -154,18 +155,29 @@ def two_shot_all_reduce(x: jax.Array, axis: str = TP_AXIS,
 
     Guarding (faults.guard.building active): one extra trailing output,
     the stacked (2, 1+cap, GUARD_WORDS) guard buffers of the RS and AG
-    legs (both legs' watchdog trips are attributable separately)."""
+    legs (both legs' watchdog trips are attributable separately).
+    Metering (obs.stats.building active): one extra trailing output
+    AFTER the guard buffer — the stacked (2, 1, STAT_WORDS) stat rows
+    of the two legs (docs/observability.md "In-kernel stat rows")."""
     gbuild = _guard.active_build()
-    if gbuild is None:
+    obuild = _obs.active_build()
+    if gbuild is None and obuild is None:
         scattered = ring_reduce_scatter(x, axis, wire_format=wire_format,
                                         force_kernel=force_kernel)
         return ring_all_gather(scattered, axis, wire_format=wire_format,
                                force_kernel=force_kernel)
-    scattered, g_rs = ring_reduce_scatter(
+    res_rs = ring_reduce_scatter(
         x, axis, wire_format=wire_format, force_kernel=force_kernel)
-    out, g_ag = ring_all_gather(scattered, axis, wire_format=wire_format,
-                                force_kernel=force_kernel)
-    return out, jnp.stack([g_rs, g_ag])
+    res_rs = res_rs if isinstance(res_rs, tuple) else (res_rs,)
+    res_ag = ring_all_gather(res_rs[0], axis, wire_format=wire_format,
+                             force_kernel=force_kernel)
+    res_ag = res_ag if isinstance(res_ag, tuple) else (res_ag,)
+    out = (res_ag[0],)
+    if gbuild is not None:
+        out += (jnp.stack([res_rs[1], res_ag[1]]),)
+    if obuild is not None:
+        out += (jnp.stack([res_rs[-1], res_ag[-1]]),)
+    return out
 
 
 def all_reduce(
@@ -186,23 +198,34 @@ def all_reduce(
     native payload; XLA psum cannot express the codec)."""
     if not isinstance(axis, str):
         gbuild = _guard.active_build()
+        obuild = _obs.active_build()
         out = x
         gbufs = []
+        obufs = []
         for ax in tuple(axis):
             res = all_reduce(out, ax, method=method,
                              wire_format=wire_format,
                              error_budget=error_budget)
-            if gbuild is None:
+            if gbuild is None and obuild is None:
                 out = res
-            else:
+                continue
+            res = res if isinstance(res, tuple) else (res,)
+            out = res[0]
+            if gbuild is not None:
                 # keep every stage's guard buffer — stripping them
                 # would mute a tripped watchdog into a silently wrong
                 # result (the failure class this plane exists to kill)
-                out, g = res
+                g = res[1]
                 gbufs.append(g if g.ndim == 3 else g[None])
-        if gbuild is None:
-            return out
-        return out, jnp.concatenate(gbufs, axis=0)
+            if obuild is not None:
+                o = res[-1]
+                obufs.append(o if o.ndim == 3 else o[None])
+        ret = (out,)
+        if gbuild is not None:
+            ret += (jnp.concatenate(gbufs, axis=0),)
+        if obuild is not None:
+            ret += (jnp.concatenate(obufs, axis=0),)
+        return ret if len(ret) > 1 else out
 
     n = jax.lax.axis_size(axis)
     nbytes = x.size * x.dtype.itemsize
@@ -236,11 +259,15 @@ def all_reduce(
         else:
             method = choose_allreduce_method(nbytes, n)
     if method == AllReduceMethod.XLA:
-        return _guard.with_guard(_guard.active_build(),
-                                 jax.lax.psum(x, axis))
+        return _obs.with_stats(
+            _obs.active_build(),
+            _guard.with_guard(_guard.active_build(),
+                              jax.lax.psum(x, axis)))
     if method == AllReduceMethod.OneShot:
-        return _guard.with_guard(_guard.active_build(),
-                                 one_shot_all_reduce(x, axis))
+        return _obs.with_stats(
+            _obs.active_build(),
+            _guard.with_guard(_guard.active_build(),
+                              one_shot_all_reduce(x, axis)))
     return two_shot_all_reduce(x, axis)
 
 
@@ -279,13 +306,20 @@ def all_reduce_op(
         return _ar_xla_jit(mesh, axis)(arr)
     fmt = "auto" if wire_format == "auto" else wcodec.resolve(wire_format)
     gbuild = _guard.active_build()
-    res = _ar_op_jit(mesh, axis, method, fmt, gbuild)(arr)
-    if gbuild is None:
+    obuild = _obs.active_build()
+    res = _ar_op_jit(mesh, axis, method, fmt, gbuild,
+                     obuild is not None)(arr)
+    if gbuild is None and obuild is None:
         return res
-    out, gout = res
+    res = res if isinstance(res, tuple) else (res,)
+    out = res[0]
     import numpy as np
 
-    g = np.asarray(gout)
+    if obuild is not None:
+        _obs.consume_rows(res[-1], kernel=PROTOCOL_NAME)
+    if gbuild is None:
+        return out
+    g = np.asarray(res[1])
     trips = _guard.decode(g)
     if trips:
         if fallback == "xla":
@@ -297,25 +331,34 @@ def all_reduce_op(
 
 @functools.lru_cache(maxsize=None)
 def _ar_op_jit(mesh, axis: str, method: AllReduceMethod, fmt,
-               gbuild=None):
+               gbuild=None, metered: bool = False):
     from jax.sharding import PartitionSpec as P
 
     def fn(xs):
         import contextlib
 
         with _guard.building(gbuild.cap, gbuild.deadline) if gbuild \
-                else contextlib.nullcontext():
+                else contextlib.nullcontext(), \
+                _obs.building() if metered else contextlib.nullcontext():
             res = all_reduce(xs[0], axis, method=method, wire_format=fmt)
-        if gbuild is None:
+        if gbuild is None and not metered:
             return res
-        out, g = res
-        # normalize to (legs, 1+cap, WORDS) so the gathered global is
-        # decode-ready regardless of which method path traced
-        if g.ndim == 2:
-            g = g[None]
-        return out, g
+        res = res if isinstance(res, tuple) else (res,)
+        ret = (res[0],)
+        if gbuild is not None:
+            # normalize to (legs, 1+cap, WORDS) so the gathered global
+            # is decode-ready regardless of which method path traced
+            g = res[1]
+            ret += (g[None] if g.ndim == 2 else g,)
+        if metered:
+            o = res[-1]
+            ret += (o[None] if o.ndim == 2 else o,)
+        return ret
 
-    out_specs = P() if gbuild is None else (P(), P(axis))
+    out_specs = P()
+    if gbuild is not None or metered:
+        out_specs = (P(),) + (P(axis),) * ((gbuild is not None)
+                                           + bool(metered))
     return jax.jit(
         jax.shard_map(fn, mesh=mesh, in_specs=P(axis), out_specs=out_specs,
                       check_vma=False)

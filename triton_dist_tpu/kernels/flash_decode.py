@@ -325,8 +325,11 @@ def sp_flash_decode(
         wp = partials_buf_shape(b, hq, d)[1]
         payload = jnp.concatenate([o.reshape(b, hq * d), lse], axis=-1)
         payload = jnp.pad(payload, ((0, 0), (0, wp - w)))
-        gathered, new_buf = ll_all_gather(payload, ll_buf, call_count,
-                                          axis)
+        from triton_dist_tpu.faults import guard as _guard
+        from triton_dist_tpu.obs import stats as _obs
+
+        gathered, new_buf = _guard.primary(_obs.primary(
+            ll_all_gather(payload, ll_buf, call_count, axis)))
         n = gathered.shape[0]
         o_parts = gathered[..., :hq * d].reshape(n, b, hq, d)
         lse_parts = gathered[..., hq * d:w]

@@ -57,8 +57,9 @@ def gemm_ar(
     # tp_mlp.dist_fwd)
     scattered = primary(gemm_rs(a, b, axis, config=config))
     from triton_dist_tpu.faults import guard as _guard
+    from triton_dist_tpu.obs import stats as _obs
 
-    return _guard.primary(ring_all_gather(scattered, axis))
+    return _guard.primary(_obs.primary(ring_all_gather(scattered, axis)))
 
 
 def gemm_ar_ref(a: jax.Array, b: jax.Array, axis: str = TP_AXIS) -> jax.Array:

@@ -40,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.verify import capture as _vcap
 from triton_dist_tpu.lang.core import (
     compiler_params,
@@ -68,21 +69,26 @@ def create_ll_ag_buffer(x_shape, dtype, n: int,
     return jnp.zeros((2, n) + tuple(x_shape), dtype)
 
 
-def _ll_ag_kernel(axis: str, n: int, gbuild, flags_ref, x_ref, buf_in,
-                  buf_out, *refs):
-    if gbuild is not None:
-        # outputs precede scratch: gbuf is output 1, gcur the last scratch
-        gbuf, send_sem, recv_sems, local_sem, gcur = refs
-    else:
-        send_sem, recv_sems, local_sem = refs
-        gbuf = gcur = None
+def _ll_ag_kernel(axis: str, n: int, gbuild, obuild, fmtc, flags_ref,
+                  x_ref, buf_in, buf_out, *refs):
+    refs = list(refs)
+    # outputs precede scratch: gbuf/obuf follow buf_out, the obs/guard
+    # cursors are the trailing scratch entries
+    gbuf = refs.pop(0) if gbuild is not None else None
+    obuf = refs.pop(0) if obuild is not None else None
+    ocur = refs.pop() if obuild is not None else None
+    gcur = refs.pop() if gbuild is not None else None
+    send_sem, recv_sems, local_sem = refs
     parity = flags_ref[0]
     first = flags_ref[1]
     del buf_in  # aliased: access through buf_out
 
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
-    _guard.init_ctx(gctx, rank=shmem.my_pe(axis))
-    with _guard.attached(gctx):
+    me = shmem.my_pe(axis)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
+    _obs.init_ctx(octx, rank=me, fmt=fmtc)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, octx=octx)
+    _guard.init_ctx(gctx, rank=me)
+    with _guard.attached(gctx), _obs.attached(octx):
         @pl.when(first == 1)
         def _():
             # fresh context: peers must be inside the kernel before the
@@ -127,6 +133,13 @@ def ll_all_gather(
     fmt = wcodec.resolve(wire_format)
     wire = not wcodec.is_native(fmt)
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
+
+    def with_builds(res, gbuf=None, obuf=None):
+        if obuild is not None and obuf is None:
+            obuf = _obs.new_stream(obuild, fmt=_obs.fmt_code(fmt))
+        return _obs.with_stats(
+            obuild, _guard.with_guard(gbuild, res, gbuf), obuf)
 
     def decode(slots):
         # (n, rows, kw) wire slots -> (n,) + x.shape in x.dtype
@@ -138,11 +151,10 @@ def ll_all_gather(
 
     if n == 1:
         out = wcodec.roundtrip(x, fmt)[None] if wire else x[None]
-        return _guard.with_guard(gbuild, (out, buf))
+        return with_builds((out, buf))
     xw = wcodec.pack(x, fmt)
     if interpret_no_headroom():
-        return _guard.with_guard(
-            gbuild, (decode(jax.lax.all_gather(xw, axis)), buf))
+        return with_builds((decode(jax.lax.all_gather(xw, axis)), buf))
 
     call_count = jnp.asarray(call_count, jnp.int32)
     if first is None:
@@ -151,9 +163,13 @@ def ll_all_gather(
         jnp.asarray(call_count % 2, jnp.int32),
         jnp.asarray(first, jnp.int32),
     ])
-    res = _ll_ag_call(flags, xw, buf, call_count % 2, axis, n, gbuild)
+    res = _ll_ag_call(flags, xw, buf, call_count % 2, axis, n, gbuild,
+                      obuild, _obs.fmt_code(fmt))
     out, buf = res[:2]
-    gbuf = res[2] if gbuild is not None else None
+    k_res = 2
+    gbuf = res[k_res] if gbuild is not None else None
+    k_res += 1 if gbuild is not None else 0
+    obuf = res[k_res] if obuild is not None else None
     if gbuild is not None and wire and fmt.checksum:
         # detect-and-record consume edge: a corrupted slot becomes a
         # wire guard row the host raises on (WireIntegrityError via
@@ -164,50 +180,62 @@ def ll_all_gather(
         ok = jnp.all(wcodec.verify_rows(
             flat, _math.prod(x.shape[1:]), fmt))
         gbuf = _guard.stream_trip(gbuf, ok)
-    return _guard.with_guard(gbuild, (decode(out), buf), gbuf)
+    return with_builds((decode(out), buf), gbuf, obuf)
 
 
-def _ll_ag_call(flags, x, buf, parity, axis, n, gbuild=None):
-    kernel = functools.partial(_ll_ag_kernel, axis, n, gbuild)
-    out_shape = jax.ShapeDtypeStruct(buf.shape, buf.dtype)
-    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+def _ll_ag_call(flags, x, buf, parity, axis, n, gbuild=None,
+                obuild=None, fmtc=0):
+    kernel = functools.partial(_ll_ag_kernel, axis, n, gbuild, obuild,
+                               fmtc)
+    out_shape = (jax.ShapeDtypeStruct(buf.shape, buf.dtype),)
+    out_specs = (pl.BlockSpec(memory_space=pl.ANY),)
     scratch = [
         pltpu.SemaphoreType.DMA,
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA,
     ]
     if gbuild is not None:
-        out_shape = (out_shape, _guard.out_shape(gbuild))
         # explicit block shape: PrefetchScalarGridSpec does not accept
         # the shapeless SMEM spec the gridless kernels use
-        out_specs = (out_specs, pl.BlockSpec(
+        out_shape += (_guard.out_shape(gbuild),)
+        out_specs += (pl.BlockSpec(
             (1 + gbuild.cap, _guard.GUARD_WORDS),
             lambda i, *_: (0, 0),  # *_: the scalar-prefetch operand
-            memory_space=pltpu.SMEM))
+            memory_space=pltpu.SMEM),)
         scratch.append(_guard.cursor_scratch())
+    if obuild is not None:
+        out_shape += (_obs.out_shape(obuild),)
+        out_specs += (pl.BlockSpec(
+            (1, _obs.STAT_WORDS),
+            lambda i, *_: (0, 0),
+            memory_space=pltpu.SMEM),)
+        scratch.append(_obs.cursor_scratch())
+    single = len(out_shape) == 1
     res = tpu_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
-            out_specs=out_specs,
+            out_specs=out_specs[0] if single else out_specs,
             scratch_shapes=scratch,
         ),
-        out_shape=out_shape,
+        out_shape=out_shape[0] if single else out_shape,
         input_output_aliases={2: 0},
         compiler_params=compiler_params(
             has_side_effects=True,
             collective_id=next_collective_id(f"ll_ag_{axis}"),
         ),
     )(flags, x, buf)
-    buf, gbuf = (res if gbuild is not None else (res, None))
+    res = res if isinstance(res, tuple) else (res,)
+    buf = res[0]
     out = jax.lax.dynamic_index_in_dim(buf, parity, 0, keepdims=False)
-    return (out, buf) + ((gbuf,) if gbuild is not None else ())
+    return (out, buf) + tuple(res[1:])
 
 
 @functools.lru_cache(maxsize=None)
-def _ll_op_fn(mesh, axis: str, fmt=None, gbuild=None):
+def _ll_op_fn(mesh, axis: str, fmt=None, gbuild=None,
+              metered: bool = False):
     """Cached jitted executable per (mesh, axis, wire format, guard
     build): call_count and the fresh-context flag ride as traced
     arguments, so every decode step replays one compiled program (a
@@ -219,18 +247,15 @@ def _ll_op_fn(mesh, axis: str, fmt=None, gbuild=None):
 
     def per_device(x_shard, buf_shard, cc, first):
         with _guard.building(gbuild.cap, gbuild.deadline) if gbuild \
-                else contextlib.nullcontext():
+                else contextlib.nullcontext(), \
+                _obs.building() if metered else contextlib.nullcontext():
             res = ll_all_gather(x_shard, buf_shard[0], cc, axis,
                                 first=first, wire_format=fmt)
-        if gbuild is not None:
-            out, new_buf, gbuf = res
-            return out, new_buf[None], gbuf[None]
-        out, new_buf = res
-        return out, new_buf[None]
+        out, new_buf = res[:2]
+        return (out, new_buf[None]) + tuple(b[None] for b in res[2:])
 
     out_specs = (P(None, axis), P(axis))
-    if gbuild is not None:
-        out_specs += (P(axis),)
+    out_specs += (P(axis),) * ((gbuild is not None) + bool(metered))
     return jax.jit(
         jax.shard_map(
             per_device, mesh=mesh,
@@ -318,18 +343,22 @@ def ll_all_gather_op(
     fresh = not workspace.contains(name, local_shape, buf_dtype)
     buf = workspace.get(name, local_shape, buf_dtype)
     gbuild = _guard.active_build()
-    res = _ll_op_fn(mesh, axis, fmt, gbuild)(
+    obuild = _obs.active_build()
+    res = _ll_op_fn(mesh, axis, fmt, gbuild, obuild is not None)(
         x, buf, jnp.asarray(call_count, jnp.int32),
         jnp.asarray(fresh, jnp.int32),
     )
-    if gbuild is None:
-        out, new_buf = res
-        workspace.update(name, new_buf)
-        return out
-    out, new_buf, gout = res
+    out, new_buf = res[:2]
     workspace.update(name, new_buf)
+    if gbuild is None and obuild is None:
+        return out
     import numpy as np
 
+    if obuild is not None:
+        _obs.consume_rows(res[-1], kernel=PROTOCOL_NAME)
+    if gbuild is None:
+        return out
+    gout = res[2]
     trips = _guard.decode(
         np.asarray(gout).reshape(n, -1, _guard.GUARD_WORDS))
     if trips:

@@ -40,6 +40,7 @@ from triton_dist_tpu.lang.core import (
     compute_vmem_bytes,
     interpret_no_headroom,
 )
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.wire import codec as wcodec
 
@@ -50,19 +51,22 @@ class ReduceScatterMethod(enum.Enum):
     XLA = "xla"
 
 
-def _rs_unpack(casting, gbuild, refs):
+def _rs_unpack(casting, gbuild, obuild, refs):
     """Shared ref unpacking of the two ring kernels: outputs (o_ref +
-    guard buffer) precede scratch; cast_buf and the guard cursor are
-    the trailing scratch entries."""
+    guard buffer + stat row) precede scratch; cast_buf and the
+    guard/obs cursors are the trailing scratch entries."""
     refs = list(refs)
     x_ref, o_ref = refs[0], refs[1]
     del refs[:2]
     gbuf = refs.pop(0) if gbuild is not None else None
+    obuf = refs.pop(0) if obuild is not None else None
+    ocur = refs.pop() if obuild is not None else None
     gcur = refs.pop() if gbuild is not None else None
     cast_buf = refs.pop() if casting else None
     acc, stage = refs[0], refs[1]
     sems = refs[2:]
-    return x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage, sems
+    return (x_ref, o_ref, gbuf, gcur, obuf, ocur, cast_buf, acc, stage,
+            sems)
 
 
 # A ring step holds 3 chunk-sized VMEM buffers (2 accumulator slots + local
@@ -71,7 +75,7 @@ _VMEM_CHUNK_LIMIT = 4 * (1 << 20)
 
 
 def _ring_rs_kernel(axis: str, n: int, acc_dtype, casting, gbuild,
-                    *refs):
+                    obuild, *refs):
     """Ring reduce-scatter.
 
     Chunk schedule (mirrors the SM-ring of ref reduce_scatter.py:327-413):
@@ -103,16 +107,18 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, casting, gbuild,
     conflated before the wire plane; they are orthogonal. Loads cast
     through cast_buf (DMA cannot cast); the output returns in x.dtype.
     """
-    (x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage,
+    (x_ref, o_ref, gbuf, gcur, obuf, ocur, cast_buf, acc, stage,
      (ld_sem, st_sem, send_sem, recv_sem, credit_sem)) = _rs_unpack(
-        casting, gbuild, refs)
+        casting, gbuild, obuild, refs)
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
+    _obs.init_ctx(octx, rank=me)
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, octx=octx)
     _guard.init_ctx(gctx, rank=me)
-    with _guard.attached(gctx):
+    with _guard.attached(gctx), _obs.attached(octx):
         shmem.neighbor_barrier(axis, me, n)
         shmem.fault_delay(axis, "reduce_scatter")
 
@@ -180,7 +186,7 @@ def _ring_rs_kernel(axis: str, n: int, acc_dtype, casting, gbuild,
 
 
 def _ring_rs_wire_kernel(axis: str, n: int, fmt, casting, gbuild,
-                         *refs):
+                         obuild, *refs):
     """Quantized-wire ring RS: the EXACT credit/parity protocol of
     `_ring_rs_kernel` — same puts, same per-parity recv semaphores,
     same credit flow toward the left neighbor (`verify` proves the
@@ -192,16 +198,18 @@ def _ring_rs_wire_kernel(axis: str, n: int, fmt, casting, gbuild,
     the f32 contribution/accumulation buffer, and the LAST arrival is
     stored without a re-encode, so the output is exactly the f32 fold
     (wire.simulate_ring_rs replays this order bit-for-bit)."""
-    (x_ref, o_ref, gbuf, gcur, cast_buf, acc, stage,
+    (x_ref, o_ref, gbuf, gcur, obuf, ocur, cast_buf, acc, stage,
      (ld_sem, st_sem, send_sem, recv_sem, credit_sem)) = _rs_unpack(
-        casting, gbuild, refs)
+        casting, gbuild, obuild, refs)
     me = jax.lax.axis_index(axis)
     m, k = stage.shape
     left = jnp.mod(me - 1, n)
     right = jnp.mod(me + 1, n)
-    gctx = _guard.make_ctx(gbuild, gbuf, gcur)
+    octx = _obs.make_ctx(obuild, obuf, ocur)
+    _obs.init_ctx(octx, rank=me, fmt=_obs.fmt_code(fmt))
+    gctx = _guard.make_ctx(gbuild, gbuf, gcur, octx=octx)
     _guard.init_ctx(gctx, rank=me)
-    with _guard.attached(gctx):
+    with _guard.attached(gctx), _obs.attached(octx):
         shmem.neighbor_barrier(axis, me, n)
         shmem.fault_delay(axis, "reduce_scatter")
 
@@ -335,24 +343,33 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
         return _ring_rs_quantized(x, axis, n, fmt, force_kernel)
     acc_dtype = jnp.dtype(accum_dtype or x.dtype)
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
+
+    def fallback(res):
+        return _obs.with_stats(obuild, _guard.with_guard(gbuild, res))
+
     if n == 1 and not force_kernel:
-        return _guard.with_guard(gbuild, x)
+        return fallback(x)
     if interpret_no_headroom():
         if acc_dtype != x.dtype:
-            return _guard.with_guard(gbuild, jax.lax.psum_scatter(
+            return fallback(jax.lax.psum_scatter(
                 x.astype(acc_dtype), axis, tiled=True).astype(x.dtype))
-        return _guard.with_guard(
-            gbuild, jax.lax.psum_scatter(x, axis, tiled=True))
+        return fallback(jax.lax.psum_scatter(x, axis, tiled=True))
     m = x.shape[0] // n
     chunk_shape = (m,) + x.shape[1:]
     casting = acc_dtype != x.dtype
     kernel = functools.partial(_ring_rs_kernel, axis, n, acc_dtype,
-                               casting, gbuild)
+                               casting, gbuild, obuild)
     out_shape = jax.ShapeDtypeStruct(chunk_shape, x.dtype)
     out_specs = pl.BlockSpec(memory_space=pl.ANY)
     if gbuild is not None:
         out_shape = (out_shape, _guard.out_shape(gbuild))
         out_specs = (out_specs, _guard.out_spec())
+    if obuild is not None:
+        out_shape = (out_shape if isinstance(out_shape, tuple)
+                     else (out_shape,)) + (_obs.out_shape(obuild),)
+        out_specs = (out_specs if isinstance(out_specs, tuple)
+                     else (out_specs,)) + (_obs.out_spec(),)
     scratch = [
         pltpu.VMEM((2,) + chunk_shape, acc_dtype),
         pltpu.VMEM(chunk_shape, acc_dtype),
@@ -366,6 +383,8 @@ def ring_reduce_scatter(x: jax.Array, axis: str = TP_AXIS,
         scratch.append(pltpu.VMEM(chunk_shape, x.dtype))
     if gbuild is not None:
         scratch.append(_guard.cursor_scratch())
+    if obuild is not None:
+        scratch.append(_obs.cursor_scratch())
     return tpu_call(
         kernel,
         out_shape=out_shape,
@@ -391,24 +410,37 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
     travels; the kernel still pays the send-edge encode when forced,
     which is what the bench's world=1 wire arm measures)."""
     gbuild = _guard.active_build()
+    obuild = _obs.active_build()
+
+    def fallback(res):
+        row = _obs.new_stream(obuild, fmt=_obs.fmt_code(fmt)) \
+            if obuild is not None else None
+        return _obs.with_stats(obuild, _guard.with_guard(gbuild, res),
+                               row)
+
     if n == 1 and not force_kernel:
-        return _guard.with_guard(gbuild, x)
+        return fallback(x)
     if interpret_no_headroom():
         if n == 1:
-            return _guard.with_guard(gbuild, x)
-        return _guard.with_guard(gbuild, _wire_rs_xla(x, axis, n, fmt))
+            return fallback(x)
+        return fallback(_wire_rs_xla(x, axis, n, fmt))
     m = x.shape[0] // n
     flat = x.reshape(x.shape[0], -1)
     k = flat.shape[1]
     kw = wcodec.wire_cols(k, fmt)
     casting = x.dtype != jnp.float32
     kernel = functools.partial(_ring_rs_wire_kernel, axis, n, fmt,
-                               casting, gbuild)
+                               casting, gbuild, obuild)
     out_shape = jax.ShapeDtypeStruct((m, k), x.dtype)
     out_specs = pl.BlockSpec(memory_space=pl.ANY)
     if gbuild is not None:
         out_shape = (out_shape, _guard.out_shape(gbuild))
         out_specs = (out_specs, _guard.out_spec())
+    if obuild is not None:
+        out_shape = (out_shape if isinstance(out_shape, tuple)
+                     else (out_shape,)) + (_obs.out_shape(obuild),)
+        out_specs = (out_specs if isinstance(out_specs, tuple)
+                     else (out_specs,)) + (_obs.out_spec(),)
     scratch = [
         pltpu.VMEM((2, m, kw), jnp.int8),     # travelling wire slots
         pltpu.VMEM((m, k), jnp.float32),      # f32 stage/accumulator
@@ -422,6 +454,8 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
         scratch.append(pltpu.VMEM((m, k), x.dtype))
     if gbuild is not None:
         scratch.append(_guard.cursor_scratch())
+    if obuild is not None:
+        scratch.append(_obs.cursor_scratch())
     res = tpu_call(
         kernel,
         out_shape=out_shape,
@@ -438,9 +472,12 @@ def _ring_rs_quantized(x: jax.Array, axis: str, n: int, fmt,
                                        ((2, m, kw), jnp.int8))),
         ),
     )(flat)
-    out, gbuf = (res if gbuild is not None else (res, None))
-    out = out.reshape((m,) + x.shape[1:])
-    return _guard.with_guard(gbuild, out, gbuf)
+    res = res if isinstance(res, tuple) else (res,)
+    out = res[0].reshape((m,) + x.shape[1:])
+    gbuf = res[1] if gbuild is not None else None
+    obuf = res[-1] if obuild is not None else None
+    return _obs.with_stats(
+        obuild, _guard.with_guard(gbuild, out, gbuf), obuf)
 
 
 def reduce_scatter(
@@ -471,8 +508,8 @@ def reduce_scatter(
     if not wcodec.is_native(wire_format):
         # the quantized ring owns its own fallback routing (the XLA
         # psum_scatter cannot express per-hop requantization)
-        return _guard.primary(ring_reduce_scatter(
-            x, axis, accum_dtype=accum_dtype, wire_format=wire_format))
+        return _guard.primary(_obs.primary(ring_reduce_scatter(
+            x, axis, accum_dtype=accum_dtype, wire_format=wire_format)))
     if method == ReduceScatterMethod.Auto:
         n = jax.lax.axis_size(axis)
         chunk_bytes = (x.size // n) * x.dtype.itemsize
@@ -486,8 +523,8 @@ def reduce_scatter(
             return jax.lax.psum_scatter(
                 x.astype(accum_dtype), axis, tiled=True).astype(x.dtype)
         return jax.lax.psum_scatter(x, axis, tiled=True)
-    return _guard.primary(
-        ring_reduce_scatter(x, axis, accum_dtype=accum_dtype))
+    return _guard.primary(_obs.primary(
+        ring_reduce_scatter(x, axis, accum_dtype=accum_dtype)))
 
 
 def reduce_scatter_op(

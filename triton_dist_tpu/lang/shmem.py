@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.faults import guard as _guard
 from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import _compat
+from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.verify import capture as _vcap
 
 _compat.install()
@@ -138,9 +139,10 @@ class PutHandle:
         instead of hanging (the host raises DeadlineExceeded)."""
         if _guard.current() is None or self.recv_sem is None:
             self.copy.wait_recv()
-            return
-        _guard.watchdog_wait(self.copy.wait_recv, self.recv_sem,
-                             self._recv_amount(), "recv", slot=slot)
+        else:
+            _guard.watchdog_wait(self.copy.wait_recv, self.recv_sem,
+                                 self._recv_amount(), "recv", slot=slot)
+        _obs.meter_wait("sem_wait")
 
     def wait(self):
         self.wait_send()
@@ -176,8 +178,12 @@ def putmem_nbi(
     )
     copy.start()
     elems = int(math.prod(src_ref.shape))
-    return PutHandle(copy, recv_sem=recv_sem, elems=elems,
-                     nbytes=elems * jnp.dtype(src_ref.dtype).itemsize)
+    nbytes = elems * jnp.dtype(src_ref.dtype).itemsize
+    # stat-row metering (obs/stats.py): nbytes is what is actually on
+    # the wire — quantized legs put int8 wire images, so the byte
+    # ledger is per-format without a side channel
+    _obs.meter_send(nbytes)
+    return PutHandle(copy, recv_sem=recv_sem, elems=elems, nbytes=nbytes)
 
 
 def putmem(dst_ref, src_ref, send_sem, recv_sem, pe, axis: AxisName) -> None:
@@ -287,9 +293,10 @@ def signal_wait_until(sig_sem, cmp, value, site: str = "wait",
         return
     if _guard.current() is None:
         pltpu.semaphore_wait(sig_sem, value)
-        return
-    _guard.watchdog_wait(lambda: pltpu.semaphore_wait(sig_sem, value),
-                         sig_sem, value, site, slot=slot)
+    else:
+        _guard.watchdog_wait(lambda: pltpu.semaphore_wait(sig_sem, value),
+                             sig_sem, value, site, slot=slot)
+    _obs.meter_wait("sem_wait")
 
 
 def signal_read(sig_sem) -> jax.Array:
@@ -356,6 +363,7 @@ def barrier_all(axis: AxisName) -> None:
         else:
             _guard.watchdog_wait(lambda: pltpu.semaphore_wait(bsem, n),
                                  bsem, n, "barrier")
+        _obs.meter_wait("sem_wait")
 
     _compat.scoped_collective_sem(with_sem)
 
@@ -389,6 +397,7 @@ def neighbor_barrier(axis: str, me, n: int) -> None:
         else:
             _guard.watchdog_wait(lambda: pltpu.semaphore_wait(bsem, 2),
                                  bsem, 2, "barrier")
+        _obs.meter_wait("sem_wait")
 
     _compat.scoped_collective_sem(with_sem)
 

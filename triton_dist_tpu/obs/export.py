@@ -1,0 +1,101 @@
+"""Exporters: Prometheus text format + JSON snapshots.
+
+The registry's external faces (docs/observability.md "Exporters"):
+
+  to_prometheus(registry_or_snapshot) -> str
+      Prometheus text exposition (0.0.4): counters as `name_total`,
+      gauges as-is, histograms as cumulative `_bucket{le=...}` series
+      plus `_sum`/`_count` — scrape-ready (the examples/11 socket
+      server's `/metrics` line command serves exactly this).
+  to_json(registry) / write_snapshot / load_snapshot
+      the snapshot document (registry.SNAPSHOT_MAGIC tagged) that
+      `scripts/trace_report.py --metrics` renders and the flight
+      recorder embeds; loading validates the format and raises
+      ValueError on malformed input — the trace-plane strictness
+      contract (a tool that silently rendered a clobbered snapshot
+      would hide exactly what it exists to show).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Union
+
+from triton_dist_tpu.obs.registry import Registry, split_key
+
+
+def _snap(reg_or_snap: Union[Registry, dict]) -> dict:
+    if isinstance(reg_or_snap, Registry):
+        return reg_or_snap.snapshot()
+    return Registry.check_snapshot(reg_or_snap)
+
+
+def _prom_labels(labels: dict, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def to_prometheus(reg_or_snap: Union[Registry, dict]) -> str:
+    """Prometheus text format of a registry (or snapshot dict)."""
+    snap = _snap(reg_or_snap)
+    lines = []
+    typed = set()
+
+    def head(name: str, kind: str):
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for key in sorted(snap["counters"]):
+        name, labels = split_key(key)
+        head(f"{name}_total", "counter")
+        lines.append(f"{name}_total{_prom_labels(labels)} "
+                     f"{snap['counters'][key]}")
+    for key in sorted(snap["gauges"]):
+        name, labels = split_key(key)
+        head(name, "gauge")
+        lines.append(f"{name}{_prom_labels(labels)} "
+                     f"{_fmt_num(snap['gauges'][key])}")
+    for key in sorted(snap["histograms"]):
+        name, labels = split_key(key)
+        h = snap["histograms"][key]
+        head(name, "histogram")
+        cum = 0
+        for bound, count in zip(h["bounds"], h["counts"]):
+            cum += count
+            le = "+Inf" if bound is None else _fmt_num(bound)
+            le_attr = 'le="%s"' % le
+            lines.append(
+                f"{name}_bucket{_prom_labels(labels, le_attr)} {cum}")
+        lines.append(f"{name}_sum{_prom_labels(labels)} "
+                     f"{_fmt_num(h['sum'])}")
+        lines.append(f"{name}_count{_prom_labels(labels)} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def to_json(reg_or_snap: Union[Registry, dict], indent=None) -> str:
+    return json.dumps(_snap(reg_or_snap), indent=indent)
+
+
+def write_snapshot(reg_or_snap: Union[Registry, dict],
+                   path: str) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(to_json(reg_or_snap))
+    return path
+
+
+def load_snapshot(path: str) -> dict:
+    """Load + validate a snapshot JSON (ValueError on malformed)."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not JSON: {e}") from e
+    return Registry.check_snapshot(doc)

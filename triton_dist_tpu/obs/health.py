@@ -1,0 +1,230 @@
+"""SLO health plane — rolling-window rules over the metrics registry.
+
+The serving plane's operational question is not "what is ttft_p99
+right now" but "is the service inside its objectives, and if not, what
+should degrade". This module evaluates a small rule language over a
+rolling window of registry snapshots and folds the verdict into ONE
+structured health status — the signal that feeds the PR-9 degradation
+ladder (a violated rule with `action="degrade"` marks its protocol
+degraded via `faults.guard.degrade`, so entry points called with
+`fallback="xla"` start taking the safe route).
+
+Rule syntax (docs/observability.md "SLO rules"):
+
+    "<metric> < <threshold>"   |   "<metric> > <threshold>"
+
+where <metric> is one of
+
+  ttft_p99_us / ttft_p50_us     TTFT quantile over the serve_ttft_us
+  tpot_p99_us / tpot_p50_us     / serve_tpot_us histograms (computed
+                                from the CURRENT registry state — the
+                                histograms already aggregate history)
+  tokens_per_s                  retirement throughput over the window:
+                                delta(serve_tokens_out) / window wall
+  guard_trip_rate               guard trips per step over the window
+  <counter or gauge key>        any registry key, evaluated on the
+                                newest snapshot (gauge) or the window
+                                delta (counter)
+
+`<` rules violate when the measured value is >= threshold? No — a rule
+states the OBJECTIVE: "ttft_p99_us < 5000" is healthy while the
+measured p99 stays under 5000 and VIOLATED once it reaches it.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import re
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from triton_dist_tpu.obs.registry import Registry, split_key
+
+_RULE_RE = re.compile(
+    r"^\s*([A-Za-z0-9_.{}=,\-]+)\s*([<>])\s*"
+    r"([0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)\s*$")
+
+# quantile shorthand: metric name -> (histogram key, q)
+_QUANTILES = {
+    "ttft_p50_us": ("serve_ttft_us", 0.50),
+    "ttft_p99_us": ("serve_ttft_us", 0.99),
+    "tpot_p50_us": ("serve_tpot_us", 0.50),
+    "tpot_p99_us": ("serve_tpot_us", 0.99),
+}
+
+HEALTHY, DEGRADED, CRITICAL = "healthy", "degraded", "critical"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLORule:
+    """One objective. `action` decides what a violation does to the
+    overall status ("warn" -> degraded, "degrade" -> critical + the
+    named `protocol` is marked degraded in the guard registry)."""
+
+    metric: str
+    op: str          # "<" | ">"
+    threshold: float
+    action: str = "warn"          # "warn" | "degrade"
+    protocol: Optional[str] = None  # guard.degrade target for "degrade"
+
+    def __post_init__(self):
+        assert self.op in ("<", ">"), self.op
+        assert self.action in ("warn", "degrade"), self.action
+
+    @classmethod
+    def parse(cls, text: str, action: str = "warn",
+              protocol: Optional[str] = None) -> "SLORule":
+        m = _RULE_RE.match(text)
+        if m is None:
+            raise ValueError(
+                f"bad SLO rule {text!r} (want '<metric> < <num>' or "
+                "'<metric> > <num>')")
+        return cls(metric=m.group(1), op=m.group(2),
+                   threshold=float(m.group(3)), action=action,
+                   protocol=protocol)
+
+    def holds(self, value: Optional[float]) -> bool:
+        """Unmeasurable (None) objectives hold — an idle service is not
+        out of SLO."""
+        if value is None:
+            return True
+        return value < self.threshold if self.op == "<" \
+            else value > self.threshold
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: SLORule
+    value: float
+
+    def __str__(self):
+        return (f"{self.rule.metric} = {self.value:.4g} violates "
+                f"'{self.rule.metric} {self.rule.op} "
+                f"{self.rule.threshold:g}'")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthStatus:
+    status: str                  # healthy | degraded | critical
+    violations: Tuple[Violation, ...]
+    window_steps: int
+    evaluated_at_ns: int
+
+    @property
+    def healthy(self) -> bool:
+        return self.status == HEALTHY
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "violations": [str(v) for v in self.violations],
+            "window_steps": self.window_steps,
+            "evaluated_at_ns": self.evaluated_at_ns,
+        }
+
+
+class SLOMonitor:
+    """Rolling-window evaluator. `feed(registry)` once per step;
+    `evaluate(registry)` (also called by feed) returns the current
+    HealthStatus and applies degrade actions."""
+
+    def __init__(self, rules: Sequence, window: int = 32):
+        self.rules: List[SLORule] = [
+            r if isinstance(r, SLORule) else SLORule.parse(r)
+            for r in rules
+        ]
+        assert window >= 1
+        self.window = window
+        self._snaps: collections.deque = collections.deque(maxlen=window)
+        self.last: Optional[HealthStatus] = None
+
+    # -- window metrics -------------------------------------------------
+
+    def _window_delta(self, key: str) -> Optional[float]:
+        if len(self._snaps) < 2:
+            return None
+        newest, oldest = self._snaps[-1], self._snaps[0]
+        if key not in newest["counters"] and key not in oldest["counters"]:
+            return None  # absent != zero: the objective is unmeasurable
+        return float(newest["counters"].get(key, 0)
+                     - oldest["counters"].get(key, 0))
+
+    def _window_delta_base(self, base: str) -> Optional[float]:
+        """Window delta summed over every labelled series of `base`
+        (counters land as 'base{k=v}' — registry.split_key identity)."""
+        if len(self._snaps) < 2:
+            return None
+        newest, oldest = self._snaps[-1], self._snaps[0]
+        keys = {k for k in newest["counters"] if split_key(k)[0] == base}
+        keys |= {k for k in oldest["counters"] if split_key(k)[0] == base}
+        if not keys:
+            return None
+        return float(sum(newest["counters"].get(k, 0)
+                         - oldest["counters"].get(k, 0) for k in keys))
+
+    def _window_seconds(self) -> Optional[float]:
+        if len(self._snaps) < 2:
+            return None
+        dt = (self._snaps[-1]["t_ns"] - self._snaps[0]["t_ns"]) / 1e9
+        return dt if dt > 0 else None
+
+    def measure(self, metric: str, registry: Registry) -> Optional[float]:
+        """The rule language's measurement function (None =
+        unmeasurable in the current window)."""
+        q = _QUANTILES.get(metric)
+        if q is not None:
+            name, quant = q
+            if registry.hist_count(name) == 0:
+                return None
+            return registry.quantile(name, quant)
+        if metric == "tokens_per_s":
+            d = self._window_delta("serve_tokens_out")
+            secs = self._window_seconds()
+            return None if d is None or secs is None else d / secs
+        if metric == "guard_trip_rate":
+            trips = self._window_delta_base("serve_guard_trips")
+            steps = self._window_delta("serve_steps")
+            if not steps:
+                return None
+            # steps measured but no trip series yet: a clean run's
+            # rate is genuinely 0, not unmeasurable
+            return (trips or 0.0) / steps
+        g = registry.gauge(metric)
+        if g is not None:
+            return g
+        d = self._window_delta(metric)
+        if d is not None:
+            return d
+        c = registry.counter(metric)
+        return float(c) if c else None
+
+    # -- evaluation -----------------------------------------------------
+
+    def feed(self, registry: Registry) -> HealthStatus:
+        snap = registry.snapshot()
+        snap["t_ns"] = time.time_ns()
+        self._snaps.append(snap)
+        return self.evaluate(registry)
+
+    def evaluate(self, registry: Registry) -> HealthStatus:
+        violations = []
+        worst = HEALTHY
+        for rule in self.rules:
+            value = self.measure(rule.metric, registry)
+            if rule.holds(value):
+                continue
+            violations.append(Violation(rule, float(value)))
+            if rule.action == "degrade":
+                worst = CRITICAL
+                if rule.protocol is not None:
+                    from triton_dist_tpu.faults import guard as _guard
+
+                    _guard.degrade(rule.protocol)
+            elif worst == HEALTHY:
+                worst = DEGRADED
+        self.last = HealthStatus(
+            status=worst, violations=tuple(violations),
+            window_steps=len(self._snaps),
+            evaluated_at_ns=time.time_ns())
+        return self.last

@@ -1,0 +1,147 @@
+"""Flight recorder — a bounded ring of recent step snapshots, dumped
+automatically when a faults-plane trip needs its context shipped.
+
+The degradation ladder (PR 9) converts hangs into structured errors;
+what it could not do was say what the system looked like in the steps
+BEFORE the trip — by the time `DeadlineExceeded` reaches a log line,
+the queue depths, retry counts, and guard rows that explain it are
+gone. The recorder keeps the last `cap` step snapshots in memory at
+O(cap) cost:
+
+    StepSnapshot = registry DELTA since the previous snapshot
+                 + current gauges (absolute)
+                 + scheduler state summary (active/queued/retries)
+                 + the decoded guard rows of any FaultError seen
+
+and `dump()` writes the whole ring as one JSON document. The serve
+Scheduler records one snapshot per step and dumps automatically on
+quarantine (every faults-plane trip ships its context); callers can
+also dump on demand. `scripts/trace_report.py --metrics` renders dumps
+in the attribution-table style; CI uploads them as artifacts when the
+tier-1 gate fails.
+
+Dump location: `dir` argument, else $TDT_FLIGHT_DIR, else ./flightrec.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import List, Optional
+
+from triton_dist_tpu.obs.registry import Registry
+
+FLIGHT_MAGIC = "tdt-flight"
+FLIGHT_VERSION = 1
+
+
+def _trip_dict(t) -> dict:
+    """A faults.GuardTrip (or compatible) as a plain dict."""
+    return {
+        "rank": int(t.rank), "site": int(t.site),
+        "site_label": t.site_label, "slot": int(t.slot),
+        "progress": int(t.progress), "expected": int(t.expected),
+        "observed": int(t.observed), "seq": int(t.seq),
+    }
+
+
+class FlightRecorder:
+    """Bounded ring of step snapshots (thread-safe through the GIL on
+    the append path; readers copy)."""
+
+    def __init__(self, cap: int = 64, dir: Optional[str] = None):
+        assert cap >= 1
+        self.cap = cap
+        self.dir = dir
+        self._ring: collections.deque = collections.deque(maxlen=cap)
+        self._prev_snap: Optional[dict] = None
+        self._step = 0
+        self.n_dumps = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, registry: Optional[Registry] = None,
+               scheduler_state: Optional[dict] = None,
+               error=None, step: Optional[int] = None) -> dict:
+        """Append one snapshot. `error` (a FaultError) contributes its
+        decoded guard rows — the evidence a later dump must contain."""
+        snap = registry.snapshot() if registry is not None else None
+        entry = {
+            "step": self._step if step is None else int(step),
+            "t_ns": time.time_ns(),
+            "metrics_delta": (Registry.delta(snap, self._prev_snap)
+                              if snap is not None else None),
+            "gauges": dict(snap["gauges"]) if snap is not None else {},
+            "scheduler": dict(scheduler_state or {}),
+            "guard_rows": [_trip_dict(t)
+                           for t in getattr(error, "trips", []) or []],
+            "error": None if error is None else repr(error),
+        }
+        self._prev_snap = snap
+        self._step = entry["step"] + 1
+        self._ring.append(entry)
+        return entry
+
+    def snapshots(self) -> List[dict]:
+        return list(self._ring)
+
+    @property
+    def last(self) -> Optional[dict]:
+        return self._ring[-1] if self._ring else None
+
+    # -- dump / load ----------------------------------------------------
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> str:
+        """Write the ring as one JSON document; returns the path."""
+        if path is None:
+            d = self.dir or os.environ.get("TDT_FLIGHT_DIR", "flightrec")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}"
+                   f"_{os.getpid()}_{self.n_dumps}.json")
+        doc = {
+            "magic": FLIGHT_MAGIC,
+            "version": FLIGHT_VERSION,
+            "reason": reason,
+            "dumped_at_ns": time.time_ns(),
+            "snapshots": self.snapshots(),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        self.n_dumps += 1
+        return path
+
+
+def check_dump(doc: dict) -> dict:
+    """Validate a flight-recorder document (trace_report strictness);
+    returns it, raises ValueError on malformed input."""
+    if not isinstance(doc, dict) or doc.get("magic") != FLIGHT_MAGIC:
+        raise ValueError(
+            "not a flight-recorder dump (magic="
+            f"{doc.get('magic') if isinstance(doc, dict) else None!r})")
+    snaps = doc.get("snapshots")
+    if not isinstance(snaps, list):
+        raise ValueError("flight dump: snapshots missing or not a list")
+    for i, s in enumerate(snaps):
+        if not isinstance(s, dict) or "step" not in s \
+                or "guard_rows" not in s:
+            raise ValueError(f"flight dump: snapshot {i} malformed")
+        for r in s["guard_rows"]:
+            if not isinstance(r, dict) or "site" not in r \
+                    or "rank" not in r:
+                raise ValueError(
+                    f"flight dump: snapshot {i} guard row malformed")
+    return doc
+
+
+def load_dump(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not JSON: {e}") from e
+    return check_dump(doc)

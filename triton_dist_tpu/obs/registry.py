@@ -1,0 +1,325 @@
+"""Metrics registry — counters, gauges, fixed-log-bucket histograms.
+
+The always-on tier of the two-tier observability story
+(docs/observability.md): where `trace/` is opt-in, per-run, and decoded
+offline, this registry is cheap enough to leave running under
+production traffic — every operation is a dict lookup plus a few numpy
+scalar updates under one lock, no jax, no device round trips. The serve
+plane streams into it at retirement (TTFT/TPOT histograms), per step
+(queue/pool/slot gauges), and at every policy decision (admission /
+eviction / preemption / retry / quarantine / guard-trip counters by
+site); the in-kernel stat rows (`obs/stats.py`) fold into it through
+`stats.record_stats`.
+
+Design constraints, in order:
+
+  deterministic   fixed log-spaced bucket bounds (a power-of-`growth`
+                  ladder between lo and hi) — two registries built with
+                  the same spec always have identical bucket edges, so
+                  snapshots from different workers/steps MERGE exactly
+                  (bucket-wise addition), the property streaming
+                  percentile sketches give up.
+  pure numpy      no jax imports: the registry must be importable (and
+                  cheap) in host threads, exporters, and report tools.
+  thread-safe     one lock per registry; the serve scheduler's
+                  background thread and client threads share it.
+  snapshot/delta  `snapshot()` is a plain-dict value; `delta(prev)`
+                  subtracts counter-like state (the flight recorder's
+                  per-step record), `merge(other)` adds it (multi-worker
+                  aggregation). Gauges are last-write in both.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+# snapshot format tag (obs/export.py + scripts/trace_report.py --metrics)
+SNAPSHOT_MAGIC = "tdt-metrics"
+
+
+def _label_key(name: str, labels: Optional[dict]) -> str:
+    """Canonical flat key: name{k=v,...} with sorted label keys — the
+    Prometheus identity convention, so a (name, labels) pair is one
+    time series everywhere (registry, snapshot, exporters)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def split_key(key: str) -> Tuple[str, dict]:
+    """Inverse of the flat-key convention (exporters need the parts)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        if part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def log_buckets(lo: float, hi: float, growth: float = 1.25) -> np.ndarray:
+    """Fixed log-spaced bucket UPPER bounds covering [lo, hi]: the
+    ladder lo * growth^i, extended one rung past hi, with +inf as the
+    final overflow bound. growth=1.25 bounds the quantile estimation
+    error at ~12% of the value — tight enough for p99 SLO math, small
+    enough (~60 buckets across 6 decades) to snapshot every step."""
+    assert 0 < lo < hi and growth > 1, (lo, hi, growth)
+    n = int(math.ceil(math.log(hi / lo) / math.log(growth))) + 1
+    bounds = lo * np.power(growth, np.arange(n + 1, dtype=np.float64))
+    return np.concatenate([bounds, [np.inf]])
+
+
+class Histogram:
+    """Fixed-log-bucket histogram: counts per bucket + exact count /
+    sum / min / max. Quantiles interpolate log-linearly inside the
+    bucket, which keeps the relative error under (growth - 1)/2."""
+
+    kind = "histogram"
+
+    def __init__(self, bounds: np.ndarray):
+        self.bounds = np.asarray(bounds, np.float64)
+        self.counts = np.zeros(len(self.bounds), np.int64)
+        self.total = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = int(np.searchsorted(self.bounds, v, side="left"))
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def quantile(self, q: float) -> float:
+        """q in [0, 1]; 0.0 when empty. Clamped to the exact observed
+        min/max so p0/p100 are honest despite bucketing."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        cum = np.cumsum(self.counts)
+        i = int(np.searchsorted(cum, target, side="left"))
+        lo = self.bounds[i - 1] if i > 0 else self.min
+        hi = self.bounds[i]
+        if not np.isfinite(hi):
+            hi = self.max
+        lo = max(float(lo), 1e-12)
+        hi = max(float(hi), lo)
+        prev = float(cum[i - 1]) if i > 0 else 0.0
+        frac = (target - prev) / max(float(self.counts[i]), 1.0)
+        frac = min(max(frac, 0.0), 1.0)
+        est = lo * (hi / lo) ** frac
+        return float(min(max(est, self.min), self.max))
+
+    def state(self) -> dict:
+        return {
+            "kind": "histogram",
+            "bounds": [None if not np.isfinite(b) else float(b)
+                       for b in self.bounds],
+            "counts": [int(c) for c in self.counts],
+            "count": int(self.total),
+            "sum": float(self.sum),
+            "min": None if self.total == 0 else float(self.min),
+            "max": None if self.total == 0 else float(self.max),
+        }
+
+    @classmethod
+    def from_state(cls, d: dict) -> "Histogram":
+        bounds = np.asarray(
+            [np.inf if b is None else b for b in d["bounds"]], np.float64)
+        h = cls(bounds)
+        h.counts = np.asarray(d["counts"], np.int64).copy()
+        h.total = int(d["count"])
+        h.sum = float(d["sum"])
+        h.min = math.inf if d.get("min") is None else float(d["min"])
+        h.max = -math.inf if d.get("max") is None else float(d["max"])
+        return h
+
+
+# default histogram spec per metric-name PREFIX: latency-class metrics
+# in microseconds span 10us..100s; byte/tick metrics span wider
+DEFAULT_HIST_SPEC = (10.0, 1e8, 1.25)
+
+
+class Registry:
+    """One metrics plane: counters (monotone), gauges (last write),
+    histograms (fixed log buckets). All methods thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._hist_spec: Dict[str, tuple] = {}
+
+    # -- writes ---------------------------------------------------------
+
+    def inc(self, name: str, value: int = 1, **labels) -> None:
+        """Monotone counter increment (negative increments are a
+        programming error — counters only move forward)."""
+        assert value >= 0, f"counter {name} decremented by {value}"
+        key = _label_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + int(value)
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[_label_key(name, labels)] = float(value)
+
+    def declare_histogram(self, name: str, lo: float, hi: float,
+                          growth: float = 1.25) -> None:
+        """Pin a histogram's bucket spec before first observe (merge
+        requires identical bounds, so specs are per-name, declared
+        once)."""
+        with self._lock:
+            self._hist_spec[name] = (lo, hi, growth)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = _label_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                lo, hi, growth = self._hist_spec.get(name,
+                                                     DEFAULT_HIST_SPEC)
+                h = self._hists[key] = Histogram(log_buckets(lo, hi,
+                                                             growth))
+            h.observe(value)
+
+    # -- reads ----------------------------------------------------------
+
+    def counter(self, name: str, **labels) -> int:
+        with self._lock:
+            return self._counters.get(_label_key(name, labels), 0)
+
+    def gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(_label_key(name, labels))
+
+    def quantile(self, name: str, q: float, **labels) -> float:
+        with self._lock:
+            h = self._hists.get(_label_key(name, labels))
+            return 0.0 if h is None else h.quantile(q)
+
+    def hist_count(self, name: str, **labels) -> int:
+        with self._lock:
+            h = self._hists.get(_label_key(name, labels))
+            return 0 if h is None else h.total
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self._counters) | set(self._gauges)
+                          | set(self._hists))
+
+    # -- snapshot / delta / merge ---------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain-dict value of the whole registry — JSON-serializable,
+        the unit the flight recorder rings and the exporters render."""
+        with self._lock:
+            return {
+                "magic": SNAPSHOT_MAGIC,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.state()
+                               for k, h in self._hists.items()},
+            }
+
+    @staticmethod
+    def check_snapshot(doc: dict) -> dict:
+        """Validate a snapshot document (the exporters/report strictness
+        contract); returns it. Raises ValueError on malformed input."""
+        if not isinstance(doc, dict) or doc.get("magic") != SNAPSHOT_MAGIC:
+            raise ValueError(
+                f"not a metrics snapshot (magic={doc.get('magic')!r} "
+                f"!= {SNAPSHOT_MAGIC!r})" if isinstance(doc, dict)
+                else "not a metrics snapshot (not an object)")
+        for sect in ("counters", "gauges", "histograms"):
+            if not isinstance(doc.get(sect), dict):
+                raise ValueError(f"snapshot section {sect!r} missing or "
+                                 "not an object")
+        for k, h in doc["histograms"].items():
+            if not isinstance(h, dict) or "counts" not in h \
+                    or "bounds" not in h:
+                raise ValueError(f"histogram {k!r} malformed")
+            if len(h["counts"]) != len(h["bounds"]):
+                raise ValueError(
+                    f"histogram {k!r}: {len(h['counts'])} counts vs "
+                    f"{len(h['bounds'])} bounds")
+        return doc
+
+    @staticmethod
+    def delta(cur: dict, prev: Optional[dict]) -> dict:
+        """cur - prev over counter-like state (counters + histogram
+        counts/sums); gauges ride as cur's values. prev=None returns cur
+        whole — the flight recorder's first ring entry."""
+        if prev is None:
+            return cur
+        out = {"magic": SNAPSHOT_MAGIC, "gauges": dict(cur["gauges"])}
+        out["counters"] = {
+            k: v - prev["counters"].get(k, 0)
+            for k, v in cur["counters"].items()
+            if v - prev["counters"].get(k, 0) != 0
+        }
+        hists = {}
+        for k, h in cur["histograms"].items():
+            p = prev["histograms"].get(k)
+            if p is None:
+                hists[k] = h
+                continue
+            dcounts = [a - b for a, b in zip(h["counts"], p["counts"])]
+            if any(dcounts):
+                hists[k] = dict(h, counts=dcounts,
+                                count=h["count"] - p["count"],
+                                sum=h["sum"] - p["sum"])
+        out["histograms"] = hists
+        return out
+
+    def merge(self, other: dict) -> None:
+        """Fold a snapshot (e.g. another worker's) into this registry:
+        counters and histogram buckets add (bounds must match — the
+        deterministic-buckets property), gauges last-write."""
+        Registry.check_snapshot(other)
+        with self._lock:
+            for k, v in other["counters"].items():
+                self._counters[k] = self._counters.get(k, 0) + int(v)
+            self._gauges.update(other["gauges"])
+            for k, hd in other["histograms"].items():
+                h = Histogram.from_state(hd)
+                mine = self._hists.get(k)
+                if mine is None:
+                    self._hists[k] = h
+                    continue
+                if not np.array_equal(mine.bounds, h.bounds):
+                    raise ValueError(
+                        f"histogram {k!r}: bucket bounds differ — "
+                        "snapshots only merge across identical specs")
+                mine.counts += h.counts
+                mine.total += h.total
+                mine.sum += h.sum
+                mine.min = min(mine.min, h.min)
+                mine.max = max(mine.max, h.max)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+def summarize_values(values: Iterable[float], name: str,
+                     registry: Registry, unit_lo: float = 10.0,
+                     unit_hi: float = 1e8) -> None:
+    """Stream a batch of observations into `registry[name]` (helper for
+    call sites migrating off ad-hoc percentile math)."""
+    registry.declare_histogram(name, unit_lo, unit_hi)
+    for v in values:
+        registry.observe(name, v)

@@ -167,11 +167,22 @@ class Detokenizer:
         return "".join(self.piece(t) for t in toks)
 
 
+# fine-grained latency buckets: growth=1.05 bounds the quantile error
+# at ~2.5% — tight enough for the bench artifact's p99 columns while
+# keeping the merge-exactly property of fixed log buckets
+LATENCY_BUCKETS = (10.0, 1e8, 1.05)  # us span: 10us .. 100s
+
+
 def summarize(requests) -> dict:
     """Aggregate serving metrics over finished requests: tokens/s over
     the span, p50/p99 TTFT and TPOT in microseconds — the bench.py
-    serving schema (docs/serving.md has the methodology)."""
-    import numpy as np
+    serving schema (docs/serving.md has the methodology). Quantiles run
+    on `obs.registry.Histogram` (fixed log buckets, the always-on
+    plane's one quantile definition) instead of the bespoke
+    np.percentile math this function used to carry — so an offline
+    summary and a live `Scheduler.metrics()` read of the same traffic
+    agree by construction."""
+    from triton_dist_tpu.obs.registry import Histogram, log_buckets
 
     done = [r for r in requests if r.state == RequestState.FINISHED
             and r.token_times]
@@ -180,20 +191,23 @@ def summarize(requests) -> dict:
     t0 = min(r.t_submit for r in done)
     t1 = max(r.token_times[-1] for r in done)
     n_tok = sum(len(r.out_tokens) for r in done)
-    ttfts = np.array([r.ttft_us() for r in done], np.float64)
-    tpots = np.array([r.tpot_us() for r in done
-                      if r.tpot_us() is not None], np.float64)
+    bounds = log_buckets(*LATENCY_BUCKETS)
+    ttft, tpot = Histogram(bounds), Histogram(bounds)
+    for r in done:
+        ttft.observe(r.ttft_us())
+        if r.tpot_us() is not None:
+            tpot.observe(r.tpot_us())
 
-    def pct(a, q):
-        return round(float(np.percentile(a, q)), 2) if a.size else 0.0
+    def pct(h, q):
+        return round(h.quantile(q), 2) if h.total else 0.0
 
     return {
         "n": len(done),
         "tokens_per_s": round(n_tok / max((t1 - t0) / 1e9, 1e-9), 2),
-        "ttft_p50_us": pct(ttfts, 50),
-        "ttft_p99_us": pct(ttfts, 99),
-        "tpot_p50_us": pct(tpots, 50),
-        "tpot_p99_us": pct(tpots, 99),
+        "ttft_p50_us": pct(ttft, 0.50),
+        "ttft_p99_us": pct(ttft, 0.99),
+        "tpot_p50_us": pct(tpot, 0.50),
+        "tpot_p99_us": pct(tpot, 0.99),
     }
 
 

@@ -49,9 +49,13 @@ from typing import List, Optional
 import numpy as np
 
 from triton_dist_tpu.faults.errors import FaultError
+from triton_dist_tpu.obs.health import SLOMonitor
+from triton_dist_tpu.obs.recorder import FlightRecorder
+from triton_dist_tpu.obs.registry import Registry
 from triton_dist_tpu.serve.kv_pool import KVPool, PoolExhausted, pages_for
 from triton_dist_tpu.serve.queue import RequestQueue
 from triton_dist_tpu.serve.request import (
+    LATENCY_BUCKETS,
     Detokenizer,
     Request,
     RequestState,
@@ -82,6 +86,9 @@ class Scheduler:
         detokenizer: Optional[Detokenizer] = None,
         max_step_retries: int = 2,
         retry_backoff_s: float = 0.005,
+        registry: Optional[Registry] = None,
+        recorder: Optional[FlightRecorder] = None,
+        slo: Optional[SLOMonitor] = None,
     ):
         page = page or _default_page(engine.max_len)
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
@@ -129,6 +136,17 @@ class Scheduler:
         self._spans: List[tuple] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # -- always-on telemetry (docs/observability.md): the metrics
+        # registry every policy decision streams into, the flight
+        # recorder that ships context with every faults-plane trip,
+        # and the optional SLO monitor feeding the degradation ladder
+        self.obs = registry if registry is not None else Registry()
+        self.obs.declare_histogram("serve_ttft_us", *LATENCY_BUCKETS)
+        self.obs.declare_histogram("serve_tpot_us", *LATENCY_BUCKETS)
+        self.recorder = recorder if recorder is not None \
+            else FlightRecorder(cap=64)
+        self.slo = slo
+        self.last_flight_dump: Optional[str] = None
 
     # -- client API -----------------------------------------------------
 
@@ -165,7 +183,12 @@ class Scheduler:
         # overwrite a prefill phase the scheduler thread already opened
         # (a QueueFull rejection leaves only the stamp, never a span)
         self._begin_phase(req, "queued")
-        self.queue.submit(req)
+        try:
+            self.queue.submit(req)
+        except Exception:
+            self.obs.inc("serve_rejected", site="queue_full")
+            raise
+        self.obs.inc("serve_submitted")
         self.requests.append(req)
         return req
 
@@ -234,7 +257,8 @@ class Scheduler:
             # every slot stalled on pages: evict the most-victimizable
             # to guarantee progress (its pages feed the others)
             victim = min(self.active.values(), key=self._victim_order)
-            self._evict(victim)
+            self._evict(victim, site="progress")
+            self._observe_step()
             return True
 
         toks = self._run_step(tokens, n_valid, temps, keys, plans)
@@ -242,6 +266,7 @@ class Scheduler:
             # step failed beyond its retry budget; the poisoning
             # request is quarantined — survivors rerun next step from
             # unchanged pool state (Worker.step's failure contract)
+            self._observe_step()
             return True
 
         for slot, req, n, emits in plans:
@@ -254,6 +279,7 @@ class Scheduler:
                     self._emit(req, int(toks[slot]))
             else:
                 self._emit(req, int(toks[slot]))
+        self._observe_step()
         return True
 
     def _run_step(self, tokens, n_valid, temps, keys, plans):
@@ -271,6 +297,8 @@ class Scheduler:
             except FaultError as e:
                 last_err = e
                 self.n_step_retries += 1
+                self.obs.inc("serve_retries", site=type(e).__name__)
+                self._count_guard_trips(e)
                 self._spans.append(
                     (f"step/retry{attempt}", t0, time.perf_counter_ns()))
                 if attempt < self.max_step_retries:
@@ -281,14 +309,38 @@ class Scheduler:
         self._quarantine(victim, last_err)
         return None
 
+    def _count_guard_trips(self, err) -> None:
+        """Guard-trip counters by wait site (the decoded rows a
+        DeadlineExceeded carries; a trip-less FaultError counts at its
+        class name, so injected host-level faults are visible too)."""
+        trips = getattr(err, "trips", None) or []
+        if not trips:
+            self.obs.inc("serve_guard_trips", site=type(err).__name__)
+            return
+        for t in trips:
+            self.obs.inc("serve_guard_trips", site=t.site_label)
+
     def _quarantine(self, req: Request, err) -> None:
         """Retire the suspected poisoner as FAILED (stream closes, the
         client unblocks with a structured reason); its pages feed the
-        survivors."""
+        survivors. The flight recorder dumps here: every quarantine
+        ships the ring of step snapshots — registry deltas, gauges,
+        scheduler state, and the decoded guard rows of the fatal error
+        — so the trip arrives with its context (docs/observability.md
+        "Flight recorder")."""
         now = time.perf_counter_ns()
         self._spans.append((f"req{req.request_id}/quarantined", now, now))
         self.quarantined.append(req)
+        self.obs.inc("serve_quarantined")
         self._retire(req, f"quarantined: {err!r}", RequestState.FAILED)
+        self.recorder.record(registry=self.obs,
+                             scheduler_state=self._state_summary(),
+                             error=err, step=self.worker.n_steps)
+        try:
+            self.last_flight_dump = self.recorder.dump(
+                reason=f"quarantine req{req.request_id}: {err!r}"[:200])
+        except OSError:
+            pass  # an unwritable dump dir must not kill the batch
 
     def run(self, max_steps: int = 100_000) -> None:
         """Drive steps until queue and slots drain."""
@@ -312,6 +364,19 @@ class Scheduler:
                     idle = not self.step()
                 except BaseException as e:  # noqa: BLE001 — see docstring
                     self.error = e
+                    # the thread is dying: ship the flight-recorder
+                    # context (ring + this error's guard rows) before
+                    # the clients are failed — a dump failure must not
+                    # mask the original error
+                    try:
+                        self.recorder.record(
+                            registry=self.obs,
+                            scheduler_state=self._state_summary(),
+                            error=e, step=self.worker.n_steps)
+                        self.last_flight_dump = self.recorder.dump(
+                            reason=f"scheduler error: {e!r}"[:200])
+                    except OSError:
+                        pass
                     self._fail_all(f"scheduler error: {e!r}")
                     return
                 if idle:
@@ -342,10 +407,73 @@ class Scheduler:
 
     # -- metrics / observability ---------------------------------------
 
+    def _state_summary(self) -> dict:
+        """The scheduler-state block of a flight-recorder snapshot."""
+        return {
+            "n_steps": self.worker.n_steps,
+            "active": {int(s): r.request_id
+                       for s, r in self.active.items()},
+            "queue_depth": len(self.queue),
+            "step_retries": self.n_step_retries,
+            "quarantined": len(self.quarantined),
+        }
+
+    def _observe_step(self) -> None:
+        """Per-step telemetry: pressure gauges, the step counter, one
+        flight-recorder ring entry, and the SLO evaluation that feeds
+        the degradation ladder. O(registry size) host work — the
+        always-on budget."""
+        self.obs.inc("serve_steps")
+        self.obs.set_gauge("serve_queue_depth", len(self.queue))
+        self.obs.set_gauge("serve_active_slots", len(self.active))
+        self.obs.set_gauge("serve_pool_free_pages",
+                           self.pool.free_pages())
+        self.obs.set_gauge("serve_pool_used_pages",
+                           self.pool.used_pages())
+        self.obs.set_gauge(
+            "serve_pool_occupancy",
+            self.pool.used_pages() / max(self.pool.capacity, 1))
+        self.recorder.record(registry=self.obs,
+                             scheduler_state=self._state_summary(),
+                             step=self.worker.n_steps)
+        if self.slo is not None:
+            self.slo.feed(self.obs)
+
     def metrics(self) -> dict:
+        """The serving metrics schema (docs/observability.md pins the
+        key families; tests/test_serve.py pins keys-travel-together and
+        counter monotonicity). Latency summary keys come from
+        `summarize` — whose quantiles now run on the same registry
+        Histogram definition — plus the registry's policy counters and
+        pressure gauges, and the SLO health block when a monitor is
+        attached."""
         out = summarize(self.requests)
         out["quarantined"] = len(self.quarantined)
         out["step_retries"] = self.n_step_retries
+        snap = self.obs.snapshot()["counters"]
+        for key, name in (
+            ("submitted", "serve_submitted"),
+            ("rejected", "serve_rejected{site=queue_full}"),
+            ("admitted", "serve_admitted"),
+            ("evicted", "serve_evicted"),
+            ("preempted", "serve_evicted{site=preemption}"),
+            ("retries", "serve_retries"),
+            ("guard_trips", "serve_guard_trips"),
+            ("steps", "serve_steps"),
+            ("tokens_out", "serve_tokens_out"),
+        ):
+            base, _, _ = name.partition("{")
+            if "{" in name:
+                out[key] = snap.get(name, 0)
+            else:
+                out[key] = sum(v for k, v in snap.items()
+                               if k == base or k.startswith(base + "{"))
+        out["queue_depth"] = len(self.queue)
+        out["active_slots"] = len(self.active)
+        out["pool_free_pages"] = self.pool.free_pages()
+        out["pool_used_pages"] = self.pool.used_pages()
+        if self.slo is not None and self.slo.last is not None:
+            out["health"] = self.slo.last.to_dict()
         return out
 
     def timeline(self):
@@ -364,7 +492,7 @@ class Scheduler:
             return True
         victim = self._pick_victim(req)
         while victim is not None:
-            self._evict(victim)
+            self._evict(victim, site="growth")
             if self.pool.ensure(slot, upto):
                 return True
             victim = self._pick_victim(req)
@@ -402,7 +530,8 @@ class Scheduler:
                          if a.priority < req.priority]
                 if not cands:
                     return
-                self._evict(min(cands, key=self._victim_order))
+                self._evict(min(cands, key=self._victim_order),
+                            site="preemption")
                 continue
             self.queue.pop()
             try:
@@ -416,14 +545,16 @@ class Scheduler:
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
             self.active[slot] = req
+            self.obs.inc("serve_admitted")
             self._phase(req, "prefill")
 
-    def _evict(self, req: Request) -> None:
+    def _evict(self, req: Request, site: str = "growth") -> None:
         self.pool.release(req.slot)
         del self.active[req.slot]
         req.slot = -1
         req.pos = 0
         req.n_evictions += 1
+        self.obs.inc("serve_evicted", site=site)
         now = time.perf_counter_ns()
         self._spans.append((f"req{req.request_id}/evicted", now, now))
         self._phase(req, "queued")
@@ -432,11 +563,22 @@ class Scheduler:
     def _emit(self, req: Request, tok: int) -> None:
         piece = self.detok.piece(tok) if self.detok else None
         req._emit(tok, piece)
+        self.obs.inc("serve_tokens_out")
         if (req.eos_id is not None and tok == req.eos_id) \
                 or len(req.out_tokens) >= req.max_new_tokens:
             reason = ("eos" if req.eos_id is not None
                       and tok == req.eos_id else "length")
             self._retire(req, reason, RequestState.FINISHED)
+
+    def _observe_retired(self, req: Request) -> None:
+        """TTFT/TPOT stream into the registry histograms at retirement
+        — the live (continuously mergeable) form of what `summarize`
+        computes offline over the finished list."""
+        if req.state is not RequestState.FINISHED or not req.token_times:
+            return
+        self.obs.observe("serve_ttft_us", req.ttft_us())
+        if req.tpot_us() is not None:
+            self.obs.observe("serve_tpot_us", req.tpot_us())
 
     def _retire(self, req: Request, reason: str, state) -> None:
         self.pool.release(req.slot)
@@ -444,6 +586,7 @@ class Scheduler:
         req.slot = -1
         self._end_phase(req)
         req._finish(reason, state)
+        self._observe_retired(req)
 
     def _reap_cancelled(self) -> None:
         for slot in list(self.active):
