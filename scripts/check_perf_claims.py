@@ -75,25 +75,21 @@ REQUIRED_CLAIMS = (
     ("ag_gemm_wire_fp8_vs_native", "docs/performance.md"),
 )
 
-# Keys whose claims are REQUIRED but whose first measurement is still in
-# flight. The open-ended grace set this used to be (PR 6) was itself a
-# fail-open: an arm that silently never measured would ride the grace
-# forever. Now each entry names the bench ROUND whose artifact must
-# carry the key: the grace holds only while the newest BENCH_r*.json
-# predates that round, and the rule closes BY ITSELF the moment a
+# Keys whose claims are REQUIRED but whose first measurement is still
+# in flight. Each entry names the bench ROUND whose artifact must carry
+# the key: the grace holds only while the newest BENCH_r*.json predates
+# that round, and the rule closes BY ITSELF the moment a
 # round-N-or-later artifact exists — measured: the claim is checked;
 # absent: the required claim is unbacked and FAILS (no manual
-# bookkeeping left to forget). serve_vs_seq_tokens entered bench.py in
-# round 6, the sp_prefill family in round 7 — each key's first artifact
-# is its round's bench run.
-PENDING_FIRST_ARTIFACT = {
-    "serve_vs_seq_tokens": 6,
-    "sp_prefill_vs_ring": 7,
-    "sp_prefill_vs_xla": 7,
-    # quantized-wire family entered bench.py in round 8 (ISSUE 9)
-    "allreduce_wire_fp8_vs_native": 8,
-    "ag_gemm_wire_fp8_vs_native": 8,
-}
+# bookkeeping left to forget). EMPTY since round 6 (ISSUE 12):
+# BENCH_r06.json — the first serving-era artifact, produced on the
+# documented cpu-world1 rig (docs/performance.md "Rigs") — carries all
+# five formerly-graced keys (serve_vs_seq_tokens, the sp_prefill
+# family, the quantized-wire pair), so every required claim is now
+# CHECKED against a measurement. The mechanism stays for future keys:
+# a new metric family ships with its round number here and its claim
+# in REQUIRED_CLAIMS, and the next artifact converts it.
+PENDING_FIRST_ARTIFACT = {}
 
 
 def _artifact_round(label) -> int:

@@ -148,9 +148,12 @@ def two_shot_all_reduce(x: jax.Array, axis: str = TP_AXIS,
     unchanged) — at ~itemsize x fewer ICI bytes per hop and the drift
     measured by wire.numerics (EQuARX, arXiv 2506.17615). The semaphore
     protocols of both legs are format-invariant (verify-proved).
-    Measured: [perf:allreduce_wire_fp8_vs_native=0.15-5.0] (the wide
-    round-gated band — world=1 reads the codec edge tax, world>=2 the
-    ICI-bound wire win; see docs/performance.md "Quantized wire").
+    Measured: [perf:allreduce_wire_fp8_vs_native=0.3-60.0] (r06
+    cpu-world1 rig read 44.6 — world=1 reads the codec edge tax,
+    interpreter-amplified on that rig; world>=2 on the default rig
+    reads the ICI-bound wire win, modeled ~0.55x at n=8, so the band
+    must span both regimes until a TPU artifact lands; see
+    docs/performance.md "Quantized wire"/"Rigs").
     force_kernel: run the ring kernels even at world=1 (bench arms).
 
     Guarding (faults.guard.building active): one extra trailing output,

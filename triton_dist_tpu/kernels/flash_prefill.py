@@ -44,7 +44,9 @@ Impl selection (flash vs the `ring_attention` fallback) is priced by
 block candidates by `autotuner.prune_flash_prefill_configs`; see
 `sp_prefill_attention` (the autotuner-selectable switch) and
 docs/performance.md "Prefill regimes". Claimed against the bench artifact
-as [perf:sp_prefill_vs_ring=0.1-1.05] / [perf:sp_prefill_vs_xla=0.1-1.1].
+(first measured by the r06 cpu-world1 rig — interpreter semantics, see
+docs/performance.md "Rigs"; the default-rig S=4096 artifact re-narrows)
+as [perf:sp_prefill_vs_ring=0.3-1.4] / [perf:sp_prefill_vs_xla=0.45-2.0].
 """
 
 from __future__ import annotations

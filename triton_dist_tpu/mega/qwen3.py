@@ -357,6 +357,14 @@ class MegaQwen3:
             ),
             donate_argnums=(3,) if donate_cache else (),
         )
+        # resident multi-step decode executables, keyed on step count
+        # (decode_resident; same specs as the one-step dispatch),
+        # LRU-bounded like Engine._serve_cache — a window-size sweep
+        # must not retain one executable per steps value forever
+        self._decode_specs = (p_specs, P(None, axis), P(), c_specs)
+        self._resident_fns: dict = {}
+        self._resident_fns_max = 8
+        self._donate = donate_cache
 
     # -- per-device step (inside shard_map) ---------------------------------
 
@@ -518,4 +526,61 @@ class MegaQwen3:
         return self._decode(
             self.params, self._w_gate_up, jnp.asarray(tokens, jnp.int32),
             cache
+        )
+
+    def decode_resident(self, tokens, cache, steps: int):
+        """Device-RESIDENT decode: `steps` megakernel decode iterations
+        — kernel step, greedy sampling, KV append, token feedback —
+        inside ONE compiled dispatch (ISSUE 12: the persistent-loop
+        form of the reference's model-server decode; the host re-enters
+        once per WINDOW instead of once per token, which is exactly the
+        per-step dispatch tax the r05 engine-vs-mega gap prices).
+        Works over both cache forms; with a PagedMegaKVCache the loop
+        iterates directly over the shared page pool — a serve-plane
+        `KVPool.as_mega_cache()` export decodes in place.
+
+        tokens (B,) -> (generated ids (B, steps), cache). Greedy only
+        (argmax — the self-feeding loop's fixed point); bitwise equal
+        to `steps` repeated decode_step/argmax calls, test-pinned
+        (tests/test_serve_resident.py)."""
+        assert steps >= 1
+        assert self._trace_build is None, (
+            "decode_resident does not thread per-step trace buffers; "
+            "build the model outside trace.building()"
+        )
+        fn = self._resident_fns.pop(steps, None)
+        if fn is None:
+            fn = self._build_decode_resident(steps)
+            while len(self._resident_fns) >= self._resident_fns_max:
+                self._resident_fns.pop(next(iter(self._resident_fns)))
+        self._resident_fns[steps] = fn  # re-insert = LRU touch
+        return fn(self.params, self._w_gate_up,
+                  jnp.asarray(tokens, jnp.int32), cache)
+
+    def _build_decode_resident(self, steps: int):
+        p_specs, gu_spec, t_spec, c_specs = self._decode_specs
+
+        def per_rank(params, w_gate_up, tok, cache):
+            b = tok.shape[0]
+
+            def body(i, carry):
+                tok, cache, out = carry
+                logits, cache = self._device_step(params, w_gate_up,
+                                                  tok, cache)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return nxt, cache, out.at[:, i].set(nxt)
+
+            out0 = jnp.zeros((b, steps), jnp.int32)
+            _tok, cache, out = jax.lax.fori_loop(
+                0, steps, body, (tok, cache, out0))
+            return out, cache
+
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=self.mesh,
+                in_specs=(p_specs, gu_spec, t_spec, c_specs),
+                out_specs=(t_spec, c_specs),
+                check_vma=False,
+            ),
+            donate_argnums=(3,) if self._donate else (),
         )

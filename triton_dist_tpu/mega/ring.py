@@ -1,0 +1,405 @@
+"""Host→device work-injection ring for megakernel-resident serving.
+
+The reference's endgame is the MegaTritonKernel driving a socket model
+server (PAPER.md L7): the device holds the step loop and the host only
+FEEDS it. T3's compute-triggered communication (arXiv 2401.16677) is
+the idiom — the device reacts to work ARRIVALS instead of the host
+re-dispatching per step. This module is the wire format of that
+arrival channel, shared by the host producer (`InjectionRing`) and the
+device consumer (`device_consume` / `slot_plan`, pure jnp traceable
+into the resident step loop `models/engine.make_resident_loop`
+compiles).
+
+Two mirrored rings:
+
+  injection ring  (cap, RW) i32 — host-written per-slot
+                  admission/retirement records, consumed by the device
+                  AT STEP BOUNDARIES in publication order. A record is
+                  one row: a fixed header, the slot's page-table row
+                  (full-lifetime allocation: the host reserves every
+                  page the request can ever touch at admission, so the
+                  device never needs a mid-loop allocator), and the
+                  prompt tokens (padded; the device streams prefill
+                  chunks straight out of the ring row — no copy).
+  output ring     (out_cap, OW) i32 — device-written completion
+                  records (emitted tokens + retirement flags), drained
+                  by the host after each window so detokenization
+                  streams while the device keeps stepping.
+
+Visibility discipline (the lock-free part): `IR_SEQ` is the LAST field
+the host commits — a record is visible to the device only when its
+stored seq equals `consumed + 1`. A published-but-not-visible head
+record (torn write, crashed producer) is an ABANDONED ring: the device
+polls it a bounded number of times and exits the window with the
+`starved` flag set instead of spinning — the faults-plane watchdog
+contract (docs/robustness.md) applied to the injection channel. The
+host side (`serve.worker.ResidentWorker`) turns a starved window into
+a structured `DeadlineExceeded` guard trip, never a hang.
+
+`IR_AT_STEP` gates a visible record on the device step counter, so
+tests and arrival-replay harnesses can stagger admissions INSIDE one
+resident window (a record with at_step=s is consumed at the boundary
+of device step s, exactly as if the host had injected it then).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# -- injection record header (i32 fields) ------------------------------------
+
+IR_SEQ = 0         # 1-based publication seq; 0 = never written (the gate)
+IR_KIND = 1        # KIND_* below
+IR_SLOT = 2        # target slot lane
+IR_AT_STEP = 3     # device step this record becomes consumable at
+IR_PROMPT_LEN = 4  # admission: tokens to prefill (full history)
+IR_MAX_NEW = 5     # admission: output-token budget
+IR_TEMP_BITS = 6   # admission: f32 temperature bit pattern
+IR_SEED = 7        # admission: sampling seed (per-request key stream)
+IR_EOS = 8         # admission: eos_id + 1; 0 = no eos stop
+IR_REQID = 9       # request id (echoed in output records)
+IR_HEADER = 16     # header rows reserved (room to grow the contract)
+
+KIND_NOOP = 0      # consumed, no effect (host-side hole punching)
+KIND_ADMIT = 1
+KIND_RETIRE = 2
+
+# -- device→host output record (i32 fields) ----------------------------------
+
+OR_SEQ = 0         # 1-based, dense — the host drains in seq order
+OR_SLOT = 1
+OR_STEP = 2        # device step the record was written at
+OR_TOKEN = 3       # emitted token (-1 on a token-less retirement)
+OR_FLAGS = 4       # FLAG_* bits
+OR_REASON = 5      # REASON_* on retirement rows
+OR_REQID = 6
+OR_WIDTH = 8
+
+FLAG_EMIT = 1      # the record carries a sampled token
+FLAG_RETIRED = 2   # the slot retired at this record
+
+REASON_EOS = 1
+REASON_LENGTH = 2
+REASON_HOST = 3    # host-injected retirement (cancel / quarantine)
+
+# -- device slot-state row (K, SS_WIDTH) i32 ---------------------------------
+
+SS_ACTIVE = 0
+SS_PHASE = 1       # 0 = prefill, 1 = decode
+SS_POS = 2         # prefill progress (tokens already fed)
+SS_PROMPT_LEN = 3
+SS_MAX_NEW = 4
+SS_N_OUT = 5       # tokens emitted so far (the sampling-key index)
+SS_TEMP_BITS = 6
+SS_SEED = 7
+SS_EOS = 8         # eos_id + 1; 0 = none
+SS_LAST_TOK = 9    # decode input (the previous emission)
+SS_REC = 10        # ring row of the admission record (prompt source)
+SS_REQID = 11
+SS_WIDTH = 16
+
+
+def ring_width(max_pages: int, prompt_cap: int, chunk: int) -> int:
+    """Record width: header + page-table row + prompt region. The
+    prompt region is over-provisioned by one chunk so the device's
+    fixed-size dynamic_slice at the LAST prefill position never clamps
+    back into valid tokens (the tail reads zeros instead)."""
+    return IR_HEADER + max_pages + prompt_cap + chunk
+
+
+class OutRecord(NamedTuple):
+    """One decoded output-ring record (host side)."""
+
+    seq: int
+    slot: int
+    step: int
+    token: int
+    flags: int
+    reason: int
+    req_id: int
+
+    @property
+    def emitted(self) -> bool:
+        return bool(self.flags & FLAG_EMIT)
+
+    @property
+    def retired(self) -> bool:
+        return bool(self.flags & FLAG_RETIRED)
+
+
+def decode_out_ring(buf, count: int) -> List[OutRecord]:
+    """Decode the first `count` output records; enforces the dense
+    1-based seq discipline (a gap means the device scatter broke)."""
+    a = np.asarray(buf)
+    assert a.ndim == 2 and a.shape[1] == OR_WIDTH, f"bad out ring {a.shape}"
+    assert 0 <= count <= a.shape[0], f"out count {count} vs cap {a.shape[0]}"
+    out = []
+    for i in range(count):
+        r = a[i]
+        if int(r[OR_SEQ]) != i + 1:
+            raise ValueError(
+                f"output ring row {i} carries seq {int(r[OR_SEQ])} "
+                f"(expected {i + 1}) — device scatter drift")
+        out.append(OutRecord(
+            seq=int(r[OR_SEQ]), slot=int(r[OR_SLOT]), step=int(r[OR_STEP]),
+            token=int(r[OR_TOKEN]), flags=int(r[OR_FLAGS]),
+            reason=int(r[OR_REASON]), req_id=int(r[OR_REQID])))
+    return out
+
+
+# -- host producer ------------------------------------------------------------
+
+
+class InjectionRing:
+    """Host-side producer of injection records (numpy; the scheduler
+    thread owns it). `published` counts committed records; the device
+    reports back `consumed` after each window and the producer refuses
+    to overwrite an unreclaimable row (bounded ring, loud overflow).
+
+    Commit order matters: every field of the row is written BEFORE the
+    seq field — on real shared memory the seq store is the release
+    fence; here it is what the torn-write fault (`abandon`) omits.
+
+    Row lifetime is LONGER than consumption for admissions: the device
+    streams prefill chunks straight out of the admission row
+    (slot_plan reads `ring[SS_REC]`) for as long as the slot is in
+    PREFILL — long after the record itself was consumed at its
+    admission boundary. Every admission therefore PINS its row
+    (keyed by req_id) and `_claim_row` refuses to wrap onto a pinned
+    row; the consumer side calls `unpin` once the request's first
+    emission (prefill complete) or retirement record comes back.
+    Without the pin, ring churn during a long prefill could reclaim
+    and overwrite the row mid-stream — silently wrong tokens, the
+    exact class the resident mode's bit-identity contract forbids."""
+
+    def __init__(self, cap: int, max_pages: int, prompt_cap: int,
+                 chunk: int):
+        assert cap >= 2 and max_pages >= 1 and prompt_cap >= 1
+        self.cap = cap
+        self.max_pages = max_pages
+        self.prompt_cap = prompt_cap
+        self.chunk = chunk
+        self.width = ring_width(max_pages, prompt_cap, chunk)
+        self.buf = np.zeros((cap, self.width), np.int32)
+        self.published = 0
+        self.consumed = 0  # device-acknowledged (refreshed per window)
+        self.version = 0   # bumped per mutation (device-upload cache key)
+        self._pins = {}    # req_id -> admission record seq (1-based)
+
+    def pending(self) -> int:
+        return self.published - self.consumed
+
+    def _reclaimable(self) -> int:
+        """Records whose rows may be overwritten: consumed AND not
+        pinned by an in-flight prefill (rows recycle in FIFO order, so
+        the oldest pin caps the watermark)."""
+        floor = self.consumed
+        if self._pins:
+            floor = min(floor, min(self._pins.values()) - 1)
+        return floor
+
+    def can_claim(self) -> bool:
+        """Room for one more record without touching an unconsumed or
+        pinned row — the producer's backpressure probe (the scheduler
+        defers admissions/retirements instead of overflowing)."""
+        return self.published - self._reclaimable() < self.cap
+
+    def unpin(self, req_id: int) -> None:
+        """Release an admission row for reuse: the request's prefill
+        completed (first emission) or it retired."""
+        self._pins.pop(req_id, None)
+
+    def _claim_row(self) -> int:
+        if not self.can_claim():
+            raise RuntimeError(
+                f"injection ring overflow: {self.pending()} pending + "
+                f"{len(self._pins)} pinned record(s) at cap {self.cap} "
+                "(device not consuming, or a prefill still streaming "
+                "from its admission row)")
+        return self.published % self.cap
+
+    def _commit(self, row: int) -> None:
+        self.buf[row, IR_SEQ] = self.published + 1
+        self.published += 1
+        self.version += 1
+
+    def admit(self, slot: int, prompt, max_new: int, temperature: float,
+              seed: int, eos_id: Optional[int], req_id: int,
+              table_row, at_step: int = 0) -> None:
+        prompt = np.asarray(prompt, np.int32)
+        assert prompt.ndim == 1 and 1 <= prompt.size <= self.prompt_cap, (
+            f"prompt of {prompt.size} tokens vs cap {self.prompt_cap}")
+        table_row = np.asarray(table_row, np.int32)
+        assert table_row.shape == (self.max_pages,), (
+            f"table row {table_row.shape} != ({self.max_pages},)")
+        row = self._claim_row()
+        r = self.buf[row]
+        r[:] = 0
+        r[IR_KIND] = KIND_ADMIT
+        r[IR_SLOT] = slot
+        r[IR_AT_STEP] = at_step
+        r[IR_PROMPT_LEN] = prompt.size
+        r[IR_MAX_NEW] = max_new
+        r[IR_TEMP_BITS] = np.float32(temperature).view(np.int32)
+        r[IR_SEED] = seed
+        r[IR_EOS] = 0 if eos_id is None else eos_id + 1
+        r[IR_REQID] = req_id
+        r[IR_HEADER:IR_HEADER + self.max_pages] = table_row
+        r[IR_HEADER + self.max_pages:
+          IR_HEADER + self.max_pages + prompt.size] = prompt
+        self._commit(row)
+        self._pins[req_id] = self.published  # this record's seq
+
+    def retire(self, slot: int, req_id: int, at_step: int = 0) -> None:
+        row = self._claim_row()
+        r = self.buf[row]
+        r[:] = 0
+        r[IR_KIND] = KIND_RETIRE
+        r[IR_SLOT] = slot
+        r[IR_AT_STEP] = at_step
+        r[IR_REQID] = req_id
+        self._commit(row)
+
+    def abandon(self) -> None:
+        """Publish WITHOUT committing the record (seq stays stale): the
+        torn-write / crashed-producer fault. The device must exit its
+        bounded poll with the starved flag — never spin, never consume
+        the garbage row (faults.plan.AbandonedRing injects this)."""
+        row = self._claim_row()
+        self.buf[row, IR_SEQ] = 0
+        self.published += 1
+        self.version += 1
+
+    def ack(self, consumed: int) -> None:
+        """Fold the device's post-window consumed count back in."""
+        assert self.consumed <= consumed <= self.published, (
+            f"device consumed {consumed} outside "
+            f"[{self.consumed}, {self.published}]")
+        self.consumed = consumed
+
+
+# -- device consumer (pure jnp; traced into the resident loop) ---------------
+
+
+def head_visible(ring, published, consumed, step):
+    """Is the head record consumable right now? (seq committed AND its
+    at_step gate open.)"""
+    cap = ring.shape[0]
+    head = ring[consumed % cap]
+    return ((consumed < published)
+            & (head[IR_SEQ] == consumed + 1)
+            & (head[IR_AT_STEP] <= step))
+
+
+def head_abandoned(ring, published, consumed):
+    """Pending but not committed: the head row's seq doesn't match the
+    expected publication number (torn write / crashed producer)."""
+    cap = ring.shape[0]
+    head = ring[consumed % cap]
+    return (consumed < published) & (head[IR_SEQ] != consumed + 1)
+
+
+def device_consume(ring, published, consumed, step, slot_state, table,
+                   lengths):
+    """Consume every currently-visible record at a step boundary.
+
+    Returns (consumed, slot_state, table, lengths, retired_now) where
+    retired_now (K,) i32 flags slots a RETIRE record deactivated at
+    THIS boundary (the caller reports them out). ADMIT loads the slot
+    row, installs the record's page-table row, and zeroes the slot
+    length; RETIRE deactivates iff the record's req_id matches the
+    slot's (a stale retirement for an already-self-retired request is
+    a no-op). Bounded: consumes at most `published - consumed` rows.
+    """
+    cap = ring.shape[0]
+    max_pages = table.shape[1]
+    retired0 = jnp.zeros((slot_state.shape[0],), jnp.int32)
+
+    def cond(carry):
+        consumed, ss, tb, ln, rt = carry
+        return head_visible(ring, published, consumed, step)
+
+    def body(carry):
+        consumed, ss, tb, ln, rt = carry
+        rec_row = consumed % cap
+        rec = ring[rec_row]
+        slot = rec[IR_SLOT]
+        is_admit = rec[IR_KIND] == KIND_ADMIT
+        is_retire = ((rec[IR_KIND] == KIND_RETIRE)
+                     & (ss[slot, SS_ACTIVE] > 0)
+                     & (ss[slot, SS_REQID] == rec[IR_REQID]))
+        admit_row = (
+            jnp.zeros((SS_WIDTH,), jnp.int32)
+            .at[SS_ACTIVE].set(1)
+            .at[SS_PROMPT_LEN].set(rec[IR_PROMPT_LEN])
+            .at[SS_MAX_NEW].set(rec[IR_MAX_NEW])
+            .at[SS_TEMP_BITS].set(rec[IR_TEMP_BITS])
+            .at[SS_SEED].set(rec[IR_SEED])
+            .at[SS_EOS].set(rec[IR_EOS])
+            .at[SS_REC].set(rec_row)
+            .at[SS_REQID].set(rec[IR_REQID])
+        )
+        retired_row = ss[slot].at[SS_ACTIVE].set(0)
+        new_row = jnp.where(is_admit, admit_row,
+                            jnp.where(is_retire, retired_row, ss[slot]))
+        ss = ss.at[slot].set(new_row)
+        tb = tb.at[slot].set(jnp.where(
+            is_admit, rec[IR_HEADER:IR_HEADER + max_pages], tb[slot]))
+        ln = ln.at[slot].set(jnp.where(is_admit, 0, ln[slot]))
+        rt = rt.at[slot].set(jnp.where(is_retire, 1, rt[slot]))
+        return consumed + 1, ss, tb, ln, rt
+
+    return jax.lax.while_loop(
+        cond, body, (consumed, slot_state, table, lengths, retired0))
+
+
+def slot_plan(ring, slot_state, chunk: int, max_pages: int):
+    """Assemble the per-slot step-plan arrays the serve step consumes —
+    exactly what the host-loop scheduler builds each step, computed
+    from device slot state instead (docs/serving.md "Device-resident
+    serving"):
+
+      tokens (K, C) i32   prefill chunk (streamed from the admission
+                          record's prompt region) or [last_tok, 0...]
+      n_valid (K,) i32    chunk fill / 1 / 0 — inactive rows are zero
+      temps (K,) f32      request temperature ONLY on emitting rows
+      keys (K, 2) u32     fold_in(PRNGKey(seed), n_out) on emitting
+                          rows (the Worker.key_for derivation, traced)
+      emits (K,) bool     the row's sampled token is meaningful
+    """
+    prompt_base = IR_HEADER + max_pages
+
+    def one(ss_row):
+        active = ss_row[SS_ACTIVE] > 0
+        prefill = ss_row[SS_PHASE] == 0
+        pos = ss_row[SS_POS]
+        plen = ss_row[SS_PROMPT_LEN]
+        n_pref = jnp.minimum(chunk, plen - pos)
+        rec = ring[ss_row[SS_REC]]
+        prow = jax.lax.dynamic_slice(
+            rec, (prompt_base + pos,), (chunk,))
+        drow = (jnp.zeros((chunk,), jnp.int32)
+                .at[0].set(ss_row[SS_LAST_TOK]))
+        tokens = jnp.where(prefill, prow, drow)
+        n = jnp.where(prefill, n_pref, 1)
+        n = jnp.where(active, n, 0)
+        # zero padding columns like the host scheduler does (they are
+        # causal-masked anyway; zeroing keeps the step inputs literal)
+        tokens = jnp.where(
+            active & (jnp.arange(chunk) < n), tokens, 0)
+        emits = active & ((~prefill) | (pos + n_pref >= plen))
+        temp = jnp.where(
+            emits,
+            jax.lax.bitcast_convert_type(ss_row[SS_TEMP_BITS],
+                                         jnp.float32),
+            jnp.float32(0.0))
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(ss_row[SS_SEED]), ss_row[SS_N_OUT])
+        key = jnp.where(emits, key, jnp.zeros_like(key))
+        return tokens, n.astype(jnp.int32), temp, key, emits
+
+    return jax.vmap(one)(slot_state)

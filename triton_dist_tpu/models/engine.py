@@ -44,6 +44,50 @@ def sample_token(logits, key=None, temperature: float = 0.0):
     )
 
 
+def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
+                     params, tokens, pool_k, pool_v, table, lengths,
+                     n_valid, temps, keys):
+    """THE per-rank serve-step computation (inside shard_map): one
+    fixed-geometry (slots, chunk) forward over the paged pool's dense
+    view, per-slot sampling, and the null-page-routed KV scatter.
+    Shared VERBATIM between `make_serve_step` (the host-loop replay)
+    and `make_resident_loop` (the device-resident window) — the serve
+    plane's bit-identity discipline extends to the resident loop
+    because both compile exactly this function on identical inputs
+    (tests/test_serve_resident.py pins the loop-vs-standalone bitwise
+    equality end to end)."""
+    cache = KVCache.dense_view(pool_k, pool_v, table, lengths)
+    logits, new_cache = forward(
+        cfg, params, tokens, cache, mode=mode, axis=axis,
+        return_full_logits=True,
+    )  # logits (K, C, V) f32, new_cache k/v (L, K, T, Hkv, D)
+    bidx = jnp.arange(slots)[:, None]
+    last = logits[jnp.arange(slots),
+                  jnp.maximum(n_valid - 1, 0)]  # (K, V)
+    greedy = jnp.argmax(last, -1).astype(jnp.int32)
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(
+        keys, last / temp
+    ).astype(jnp.int32)
+    tok = jnp.where(temps > 0.0, sampled, greedy)
+
+    # scatter this step's K/V rows back into the pool: valid
+    # columns land on their table pages; padding columns are
+    # routed to page 0, the pool's reserved null page (their
+    # positions may sit past the slot's allocated pages, whose
+    # table entries still map to live pages of OTHER slots)
+    pos = lengths[:, None] + jnp.arange(chunk)[None, :]  # (K, C)
+    posc = jnp.minimum(pos, t_pool - 1)
+    valid = jnp.arange(chunk)[None, :] < n_valid[:, None]
+    pg = jnp.where(valid, table[bidx, posc // page], 0)
+    off = posc % page
+    kn = jnp.moveaxis(new_cache.k[:, bidx, posc], 3, 1)
+    vn = jnp.moveaxis(new_cache.v[:, bidx, posc], 3, 1)
+    pool_k = pool_k.at[:, :, pg, off].set(kn.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, :, pg, off].set(vn.astype(pool_v.dtype))
+    return tok, last, pool_k, pool_v
+
+
 class Engine:
     """Holds sharded params + compiled prefill/decode executables.
 
@@ -241,49 +285,14 @@ class Engine:
         mode = self.decode_mode
         axis = self.axis
         t_pool = max_pages * page
-        assert t_pool <= cfg.max_positions, (
-            f"pool horizon {t_pool} exceeds max_positions "
-            f"{cfg.max_positions} (rope table)"
-        )
-        n = int(self.mesh.shape[axis])
-        if mode in ("dist", "xla"):
-            assert (slots * chunk) % n == 0, (
-                f"sequence-sharded mode {mode!r} needs slots*chunk "
-                f"({slots}*{chunk}) divisible by tp={n}"
-            )
+        self._check_serve_geometry(slots, chunk, page, max_pages)
 
         def per_rank(params, tokens, pool_k, pool_v, table, lengths,
                      n_valid, temps, keys):
-            cache = KVCache.dense_view(pool_k, pool_v, table, lengths)
-            logits, new_cache = forward(
-                cfg, params, tokens, cache, mode=mode, axis=axis,
-                return_full_logits=True,
-            )  # logits (K, C, V) f32, new_cache k/v (L, K, T, Hkv, D)
-            bidx = jnp.arange(slots)[:, None]
-            last = logits[jnp.arange(slots),
-                          jnp.maximum(n_valid - 1, 0)]  # (K, V)
-            greedy = jnp.argmax(last, -1).astype(jnp.int32)
-            temp = jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.vmap(jax.random.categorical)(
-                keys, last / temp
-            ).astype(jnp.int32)
-            tok = jnp.where(temps > 0.0, sampled, greedy)
-
-            # scatter this step's K/V rows back into the pool: valid
-            # columns land on their table pages; padding columns are
-            # routed to page 0, the pool's reserved null page (their
-            # positions may sit past the slot's allocated pages, whose
-            # table entries still map to live pages of OTHER slots)
-            pos = lengths[:, None] + jnp.arange(chunk)[None, :]  # (K, C)
-            posc = jnp.minimum(pos, t_pool - 1)
-            valid = jnp.arange(chunk)[None, :] < n_valid[:, None]
-            pg = jnp.where(valid, table[bidx, posc // page], 0)
-            off = posc % page
-            kn = jnp.moveaxis(new_cache.k[:, bidx, posc], 3, 1)
-            vn = jnp.moveaxis(new_cache.v[:, bidx, posc], 3, 1)
-            pool_k = pool_k.at[:, :, pg, off].set(kn.astype(pool_k.dtype))
-            pool_v = pool_v.at[:, :, pg, off].set(vn.astype(pool_v.dtype))
-            return tok, last, pool_k, pool_v
+            return _serve_step_math(
+                cfg, mode, axis, slots, chunk, page, t_pool,
+                params, tokens, pool_k, pool_v, table, lengths,
+                n_valid, temps, keys)
 
         pool_spec = P(None, self.axis)
         return jax.jit(
@@ -295,6 +304,220 @@ class Engine:
                 check_vma=False,
             ),
             donate_argnums=(2, 3) if self._donate_cache else (),
+        )
+
+    def _check_serve_geometry(self, slots: int, chunk: int, page: int,
+                              max_pages: int) -> None:
+        t_pool = max_pages * page
+        assert t_pool <= self.cfg.max_positions, (
+            f"pool horizon {t_pool} exceeds max_positions "
+            f"{self.cfg.max_positions} (rope table)"
+        )
+        n = int(self.mesh.shape[self.axis])
+        if self.decode_mode in ("dist", "xla"):
+            assert (slots * chunk) % n == 0, (
+                f"sequence-sharded mode {self.decode_mode!r} needs "
+                f"slots*chunk ({slots}*{chunk}) divisible by tp={n}"
+            )
+
+    # -- resident step loop (megakernel-resident serving, ISSUE 12) ---------
+
+    def make_resident_loop(self, slots: int, chunk: int, page: int,
+                           max_pages: int, window: int,
+                           ring_cap: int = 64,
+                           prompt_cap: Optional[int] = None,
+                           poll_budget: int = 8):
+        """Compile the DEVICE-RESIDENT serve loop: up to `window` serve
+        steps inside one executable — consume work-injection records at
+        each step boundary, run the SAME per-rank step math as
+        `make_serve_step`, self-feed decode tokens, and stream
+        completions (emitted tokens + retirement flags) into a mirrored
+        output ring — so a window of W steps costs ONE dispatch instead
+        of W (the r05 `engine_decode_ms` vs `mega_decode_*` gap is pure
+        per-step dispatch tax; this loop is how the serve plane stops
+        paying it per token).
+
+        Contract (docs/serving.md "Device-resident serving"):
+
+          fn(params, ring (cap, RW) i32, published () i32,
+             consumed () i32, step0 () i32, slot_state (K, SS) i32,
+             table (K, MAXP) i32, lengths (K,) i32, pool_k, pool_v)
+          -> (consumed, executed, slot_state, table, lengths,
+              pool_k, pool_v, out_ring (out_cap, OW) i32,
+              out_count, starved)
+
+        All loop state round-trips through the call, so successive
+        windows chain seamlessly; pool buffers are donated like the
+        host-loop step. The loop exits when `window` steps executed OR
+        nothing is active and the pending-record poll budget is
+        exhausted; `starved` is set when a published head record never
+        became visible (abandoned ring — the host raises a structured
+        DeadlineExceeded from it, see serve.worker.ResidentWorker).
+
+        Per-request tokens are BITWISE what the host-loop scheduler
+        emits: both paths compile `_serve_step_math` and the device
+        plan assembly (`mega.ring.slot_plan`) reproduces the host
+        scheduler's per-step inputs field for field, including the
+        fold_in(PRNGKey(seed), n_out) sampling-key stream."""
+        prompt_cap = prompt_cap if prompt_cap is not None \
+            else max_pages * page
+        key = ("resident", slots, chunk, page, max_pages, window,
+               ring_cap, prompt_cap, poll_budget)
+        fn = self._serve_cache.pop(key, None)
+        if fn is None:
+            fn = self._build_resident_loop(slots, chunk, page, max_pages,
+                                           window, ring_cap, prompt_cap,
+                                           poll_budget)
+            while len(self._serve_cache) >= self._gen_cache_max:
+                self._serve_cache.pop(next(iter(self._serve_cache)))
+        self._serve_cache[key] = fn  # re-insert = LRU touch
+        return fn
+
+    def _build_resident_loop(self, slots: int, chunk: int, page: int,
+                             max_pages: int, window: int, ring_cap: int,
+                             prompt_cap: int, poll_budget: int):
+        from triton_dist_tpu.mega import ring as mring
+
+        cfg = self.cfg
+        mode = self.decode_mode
+        axis = self.axis
+        t_pool = max_pages * page
+        self._check_serve_geometry(slots, chunk, page, max_pages)
+        assert window >= 1 and ring_cap >= 2 and poll_budget >= 1
+        # worst case: every step emits on every slot, plus one token-
+        # less retirement record per injection-ring retire
+        out_cap = window * slots + ring_cap
+
+        def scatter_out(out_ring, out_count, step, rows_mask, slot_ids,
+                        toks, flags, reasons, reqids):
+            """Append one output record per set slot of rows_mask, in
+            slot order; non-writers scatter to the trash row out_cap."""
+            offs = jnp.cumsum(rows_mask) - rows_mask
+            rows = jnp.where(rows_mask > 0, out_count + offs, out_cap)
+            rec = jnp.stack([
+                out_count + offs + 1, slot_ids,
+                jnp.full_like(slot_ids, step), toks, flags, reasons,
+                reqids, jnp.zeros_like(slot_ids),
+            ], axis=-1)
+            return (out_ring.at[rows].set(rec),
+                    out_count + jnp.sum(rows_mask))
+
+        def per_rank(params, ring, published, consumed0, step0,
+                     slot_state, table, lengths, pool_k, pool_v):
+            out_ring0 = jnp.zeros((out_cap + 1, mring.OR_WIDTH),
+                                  jnp.int32)
+            slot_ids = jnp.arange(slots, dtype=jnp.int32)
+
+            def boundary(executed, consumed, ss, tb, ln, out, n_out):
+                """Step boundary: drain visible injection records and
+                report host-forced retirements out."""
+                step = step0 + executed
+                consumed2, ss, tb, ln, retired = mring.device_consume(
+                    ring, published, consumed, step, ss, tb, ln)
+                out, n_out = scatter_out(
+                    out, n_out, step, retired, slot_ids,
+                    jnp.full((slots,), -1, jnp.int32),
+                    jnp.full((slots,), mring.FLAG_RETIRED, jnp.int32),
+                    jnp.full((slots,), mring.REASON_HOST, jnp.int32),
+                    ss[:, mring.SS_REQID])
+                return consumed2, ss, tb, ln, out, n_out
+
+            def cond(carry):
+                (executed, consumed, idle, ss, tb, ln, pk, pv, out,
+                 n_out) = carry
+                any_active = jnp.any(ss[:, mring.SS_ACTIVE] > 0)
+                pending = consumed < published
+                return (executed < window) & (
+                    any_active | (pending & (idle < poll_budget)))
+
+            def body(carry):
+                (executed, consumed, idle, ss, tb, ln, pk, pv, out,
+                 n_out) = carry
+                consumed2, ss, tb, ln, out, n_out = boundary(
+                    executed, consumed, ss, tb, ln, out, n_out)
+                any_active = jnp.any(ss[:, mring.SS_ACTIVE] > 0)
+
+                def run_step(ss, tb, ln, pk, pv, out, n_out):
+                    step = step0 + executed
+                    tokens, n_valid, temps, keys, emits = \
+                        mring.slot_plan(ring, ss, chunk, max_pages)
+                    tok, _last, pk, pv = _serve_step_math(
+                        cfg, mode, axis, slots, chunk, page, t_pool,
+                        params, tokens, pk, pv, tb, ln,
+                        n_valid, temps, keys)
+                    ln = ln + n_valid
+                    # post-step slot-state advance (mirrors the host
+                    # scheduler's per-plan bookkeeping field for field)
+                    prefill = ss[:, mring.SS_PHASE] == 0
+                    new_pos = ss[:, mring.SS_POS] + jnp.where(
+                        prefill, n_valid, 0)
+                    completing = (prefill
+                                  & (new_pos >= ss[:, mring.SS_PROMPT_LEN])
+                                  & (ss[:, mring.SS_ACTIVE] > 0))
+                    emits_i = emits.astype(jnp.int32)
+                    n_out_new = ss[:, mring.SS_N_OUT] + emits_i
+                    eos = ss[:, mring.SS_EOS]
+                    hit_eos = emits & (eos > 0) & (tok == eos - 1)
+                    hit_len = emits & (n_out_new
+                                       >= ss[:, mring.SS_MAX_NEW])
+                    finished = hit_eos | hit_len
+                    ss = (ss
+                          .at[:, mring.SS_POS].set(new_pos)
+                          .at[:, mring.SS_PHASE].set(jnp.where(
+                              completing, 1, ss[:, mring.SS_PHASE]))
+                          .at[:, mring.SS_N_OUT].set(n_out_new)
+                          .at[:, mring.SS_LAST_TOK].set(jnp.where(
+                              emits, tok, ss[:, mring.SS_LAST_TOK]))
+                          .at[:, mring.SS_ACTIVE].set(jnp.where(
+                              finished, 0, ss[:, mring.SS_ACTIVE])))
+                    flags = (emits_i * mring.FLAG_EMIT
+                             + finished.astype(jnp.int32)
+                             * mring.FLAG_RETIRED)
+                    reasons = jnp.where(
+                        hit_eos, mring.REASON_EOS,
+                        jnp.where(hit_len, mring.REASON_LENGTH, 0))
+                    out, n_out = scatter_out(
+                        out, n_out, step, emits_i, slot_ids, tok,
+                        flags, reasons, ss[:, mring.SS_REQID])
+                    return 1, ss, tb, ln, pk, pv, out, n_out
+
+                def idle_step(ss, tb, ln, pk, pv, out, n_out):
+                    return 0, ss, tb, ln, pk, pv, out, n_out
+
+                stepped, ss, tb, ln, pk, pv, out, n_out = jax.lax.cond(
+                    any_active, run_step, idle_step,
+                    ss, tb, ln, pk, pv, out, n_out)
+                progressed = (stepped > 0) | (consumed2 > consumed)
+                idle = jnp.where(progressed, 0, idle + 1)
+                return (executed + stepped, consumed2, idle, ss, tb,
+                        ln, pk, pv, out, n_out)
+
+            carry = (jnp.int32(0), consumed0, jnp.int32(0), slot_state,
+                     table, lengths, pool_k, pool_v, out_ring0,
+                     jnp.int32(0))
+            (executed, consumed, _idle, ss, tb, ln, pk, pv, out,
+             n_out) = jax.lax.while_loop(cond, body, carry)
+            # a final boundary drain: records whose at_step gate opened
+            # on the LAST executed step (e.g. a retire targeted at the
+            # window's end) must not wait a whole extra window
+            consumed, ss, tb, ln, out, n_out = boundary(
+                executed, consumed, ss, tb, ln, out, n_out)
+            starved = mring.head_abandoned(
+                ring, published, consumed).astype(jnp.int32)
+            return (consumed, executed, ss, tb, ln, pk, pv,
+                    out[:out_cap], n_out, starved)
+
+        pool_spec = P(None, self.axis)
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=self.mesh,
+                in_specs=((self._wrap_specs[0],) + (P(),) * 7
+                          + (pool_spec, pool_spec)),
+                out_specs=((P(),) * 5 + (pool_spec, pool_spec)
+                           + (P(),) * 3),
+                check_vma=False,
+            ),
+            donate_argnums=(8, 9) if self._donate_cache else (),
         )
 
     # -- API ----------------------------------------------------------------

@@ -872,6 +872,87 @@ def estimate_serve_step_ms(
     return max(compute_ms, mem_ms)
 
 
+
+# Per-step host dispatch tax of the host-loop serve path: one python
+# step assembly + jit re-entry + host->device arg staging. The r05
+# artifact prices the same class of overhead directly: engine_decode_ms
+# 2.99 vs mega_decode_qwen3_8b_ms 2.68 — ~0.31 ms of per-step dispatch
+# on an identical-work decode. Conservative constant (the tunnel RTT of
+# the bench rig is NOT included — this is the local dispatch floor).
+SERVE_DISPATCH_US = 250.0
+# Per-step cost of the resident loop's ring poll + slot-plan assembly
+# (a handful of SMEM-class reads and a (K, SS) state update — tiny next
+# to the step itself).
+RESIDENT_POLL_US = 5.0
+
+
+def estimate_resident_step_ms(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    n_tokens: int,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+    window: int = 16,
+) -> float:
+    """Per-step cost of the megakernel-RESIDENT serve loop
+    (models/engine.make_resident_loop): the same mixed-step roofline as
+    `estimate_serve_step_ms`, plus the in-loop ring poll, plus the
+    host dispatch tax amortized over the `window` steps one launch
+    covers — the saved dispatch is the whole point (ISSUE 12: the r05
+    engine-vs-mega decode gap is pure per-step dispatch). At window=1
+    this degenerates to the host-loop step cost; the chooser walks the
+    crossover."""
+    base = estimate_serve_step_ms(
+        num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+        vocab_loc, n_tokens, kv_tokens=kv_tokens, dtype=dtype,
+        chip=chip, attn_impl=attn_impl)
+    return (base + RESIDENT_POLL_US * 1e-3
+            + SERVE_DISPATCH_US * 1e-3 / max(window, 1))
+
+
+def choose_serve_mode(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    slots: int = 4,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+    window: int = 16,
+) -> str:
+    """"resident" | "host" for the serve Scheduler (resident="auto").
+
+    Resident wins when the amortized dispatch saving beats the poll
+    overhead — which it does for any window >= ~2 at realistic shapes,
+    BUT the resident mode also gives up mid-flight eviction (full-
+    lifetime page allocation), so the chooser only flips when the
+    dispatch tax is a MATERIAL fraction of the step (>= 2% of the
+    modeled step time): on a step long enough to drown the dispatch,
+    the host loop's flexibility is worth keeping."""
+    args = (num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+            vocab_loc)
+    host_ms = estimate_serve_step_ms(
+        *args, n_tokens=max(slots, 1), kv_tokens=kv_tokens, dtype=dtype,
+        chip=chip, attn_impl=attn_impl) + SERVE_DISPATCH_US * 1e-3
+    res_ms = estimate_resident_step_ms(
+        *args, n_tokens=max(slots, 1), kv_tokens=kv_tokens, dtype=dtype,
+        chip=chip, attn_impl=attn_impl, window=window)
+    saved = host_ms - res_ms
+    return "resident" if saved >= 0.02 * host_ms else "host"
+
+
 def choose_prefill_chunk(
     num_layers: int,
     hidden: int,

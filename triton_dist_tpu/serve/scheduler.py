@@ -62,7 +62,7 @@ from triton_dist_tpu.serve.request import (
     TokenStream,
     summarize,
 )
-from triton_dist_tpu.serve.worker import Worker
+from triton_dist_tpu.serve.worker import ResidentWorker, Worker
 
 
 def _default_page(max_len: int) -> int:
@@ -89,6 +89,9 @@ class Scheduler:
         registry: Optional[Registry] = None,
         recorder: Optional[FlightRecorder] = None,
         slo: Optional[SLOMonitor] = None,
+        resident=False,
+        window: Optional[int] = None,
+        ring_cap: Optional[int] = None,
     ):
         page = page or _default_page(engine.max_len)
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
@@ -118,7 +121,37 @@ class Scheduler:
             )
             chunk = max(1, min(chunk, self.pool.t_max))
         self.chunk = chunk
-        self.worker = Worker(engine, self.pool, chunk)
+        # -- execution mode: the host loop (one dispatch per step) or
+        # the megakernel-resident window (ISSUE 12: one dispatch per
+        # `window` steps, work injected through mega.ring). "auto"
+        # consults the perf model's dispatch-tax chooser.
+        auto = resident == "auto"
+        if auto:
+            from triton_dist_tpu.perf_model import choose_serve_mode
+
+            cfg = engine.cfg
+            n = int(engine.mesh.shape[engine.axis])
+            resident = choose_serve_mode(
+                cfg.num_layers, cfg.hidden_size,
+                cfg.intermediate_size // n, cfg.num_q_heads // n,
+                cfg.num_kv_heads // n, cfg.head_dim,
+                cfg.vocab_size // n, slots=slots,
+                kv_tokens=self.pool.t_max, dtype=cfg.dtype,
+                window=window or 16,
+            ) == "resident"
+        self.resident = bool(resident)
+        if self.resident:
+            self.worker = ResidentWorker(
+                engine, self.pool, chunk, window=window or 16,
+                ring_cap=ring_cap)
+        else:
+            # under "auto" the chooser may legitimately pick the host
+            # loop: the caller's window/ring_cap are then simply moot,
+            # not a usage error
+            assert auto or (window is None and ring_cap is None), (
+                "window/ring_cap configure the resident mode — pass "
+                "resident=True (or 'auto')")
+            self.worker = Worker(engine, self.pool, chunk)
         # `queue or ...` would silently DISCARD a custom queue that is
         # currently empty (RequestQueue defines __len__, and an empty
         # queue is falsy) — the admission-control settings a caller
@@ -206,8 +239,13 @@ class Scheduler:
     # -- the step -------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduling round: admit, assemble, run, postprocess.
+        """One scheduling round. Host-loop mode: admit, assemble, run
+        ONE device step, postprocess. Resident mode: admit by writing
+        injection records, launch one device-resident WINDOW (up to
+        `window` steps in a single dispatch), drain the output ring.
         Returns False when there was nothing to do."""
+        if self.resident:
+            return self._resident_pump()
         self._reap_cancelled()
         self._admit()
         if not self.active:
@@ -282,32 +320,265 @@ class Scheduler:
         self._observe_step()
         return True
 
-    def _run_step(self, tokens, n_valid, temps, keys, plans):
-        """The degradation ladder around the device step: bounded
-        exponential-backoff retries, then quarantine of the suspected
-        poisoner. Returns the per-slot tokens, or None when the step
-        was abandoned this round (survivors rerun next step). Only
-        FaultError is degradable — a programming error stays loud."""
+    def _attempt_with_backoff(self, label, body, on_fault=None):
+        """The shared half of the degradation ladder: run `body` with
+        bounded exponential-backoff retries, streaming the retry
+        bookkeeping (retry counters by fault class, guard-trip
+        counters by site, spans) every attempt. Returns
+        (result, None) on success or (None, last_err) on exhaustion —
+        what exhaustion MEANS (quarantine a victim, re-raise a ring
+        trip) stays with the caller. Only FaultError is degradable — a
+        programming error stays loud."""
         delay = self.retry_backoff_s
         last_err = None
         for attempt in range(self.max_step_retries + 1):
             t0 = time.perf_counter_ns()
             try:
-                return self.worker.step(tokens, n_valid, temps, keys)
+                return body(), None
             except FaultError as e:
                 last_err = e
+                if on_fault is not None:
+                    on_fault(e)
                 self.n_step_retries += 1
                 self.obs.inc("serve_retries", site=type(e).__name__)
                 self._count_guard_trips(e)
                 self._spans.append(
-                    (f"step/retry{attempt}", t0, time.perf_counter_ns()))
+                    (f"{label}/retry{attempt}", t0,
+                     time.perf_counter_ns()))
                 if attempt < self.max_step_retries:
                     time.sleep(delay)
                     delay = min(delay * 2, 0.25)
+        return None, last_err
+
+    def _run_step(self, tokens, n_valid, temps, keys, plans):
+        """The degradation ladder around the device step: bounded
+        exponential-backoff retries, then quarantine of the suspected
+        poisoner. Returns the per-slot tokens, or None when the step
+        was abandoned this round (survivors rerun next step)."""
+        toks, err = self._attempt_with_backoff(
+            "step",
+            lambda: self.worker.step(tokens, n_valid, temps, keys))
+        if err is None:
+            return toks
         victim = max((req for _slot, req, _n, _e in plans),
                      key=lambda r: r.admit_seq)
-        self._quarantine(victim, last_err)
+        self._quarantine(victim, err)
         return None
+
+    # -- resident mode (megakernel-resident serving, ISSUE 12) ----------
+
+    def _resident_pump(self) -> bool:
+        """One resident round: inject admissions/retirements, launch a
+        window, drain completions. The scheduler never assembles a
+        step — its decisions travel as ring records and the device
+        self-feeds decode between boundaries (docs/serving.md
+        "Device-resident serving")."""
+        self._reap_cancelled_resident()
+        self._admit_resident()
+        if not self.active and self.worker.pending_records() == 0:
+            return False
+        t0 = time.perf_counter_ns()
+        steps0 = self.worker.n_steps
+        self.obs.set_gauge("serve_ring_depth",
+                           self.worker.pending_records())
+        records = self._run_window()
+        self._spans.append(("resident/window", t0,
+                            time.perf_counter_ns()))
+        if records is not None:
+            self._drain_records(records)
+        self.obs.inc("serve_resident_windows")
+        executed = self.worker.n_steps - steps0
+        if executed:
+            self.obs.inc("serve_resident_steps", executed)
+        self.obs.set_gauge("serve_ring_depth_post",
+                           self.worker.pending_records())
+        self._observe_step()
+        return True
+
+    def _admit_resident(self) -> None:
+        """Admission, resident form: a request needs a free slot and
+        its WHOLE lifetime of pages up front (prompt + max_new_tokens
+        — the device never grows an allocation mid-loop, so page
+        exhaustion can never stall a resident window). The admission
+        travels as a ring record carrying the page-table row and the
+        prompt; no preemption/eviction — a resident batch runs to
+        retirement (the mode trades eviction flexibility for dispatch
+        amortization; docs/serving.md)."""
+        while len(self.active) < self.max_active:
+            req = self.queue.peek()
+            if req is None:
+                return
+            if not self.worker.can_inject():
+                # ring backpressure: every reclaimable row is pending
+                # or pinned by an in-flight prefill — the admission
+                # waits a round rather than overwriting a row the
+                # device still streams from
+                return
+            slot = self.pool.free_slot()
+            total = len(req.history()) + req.max_new_tokens
+            need = max(pages_for(total, self.pool.page), 1)
+            if slot is None or self.pool.free_pages() < need:
+                return
+            self.queue.pop()
+            try:
+                self.pool.admit(slot, len(req.history()))
+                ok = self.pool.ensure(slot, total)
+                assert ok, "free_pages said yes, ensure said no"
+            except PoolExhausted:
+                self.queue.requeue(req)
+                return
+            req.slot = slot
+            req.pos = 0
+            req.state = RequestState.PREFILL
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self.active[slot] = req
+            self.obs.inc("serve_admitted")
+            self._phase(req, "prefill")
+            self.worker.admit(
+                slot, req.history(), req.max_new_tokens,
+                req.temperature, req.seed, req.eos_id, req.request_id)
+
+    def _reap_cancelled_resident(self) -> None:
+        """Cancellation, resident form: the retirement travels as a
+        ring record; the slot and its pages free when the DEVICE's
+        retirement record comes back (the device may still be writing
+        the slot's KV until the record is consumed — freeing earlier
+        could alias a live page onto a new admission). Also retries
+        retirements an earlier round deferred under ring backpressure
+        (a quarantined request whose retire could not be injected)."""
+        for slot in list(self.active):
+            req = self.active[slot]
+            wants_retire = (req.finish_reason == "cancel_requested"
+                            or req.state is RequestState.FAILED)
+            if wants_retire and not getattr(req, "_retire_sent", False):
+                if not self.worker.can_inject():
+                    return  # ring full: retried next round
+                req._retire_sent = True
+                self.worker.retire(slot, req.request_id)
+
+    def _run_window(self):
+        """The degradation ladder around the resident window (mirror
+        of _run_step): bounded exponential-backoff retries; on
+        exhaustion, a ring-watchdog trip ("inject" site: the host side
+        of the ring is broken — there is no poisoning request) is
+        re-raised, while a device/step fault quarantines the most
+        recently admitted active request. Returns the drained records,
+        or None when the round was abandoned."""
+        records, err = self._attempt_with_backoff(
+            "window", self.worker.run_window,
+            # a post-launch trip (starved ring) carries the window's
+            # drained records — fold the emissions in before retrying
+            # so a trip never eats completions
+            on_fault=lambda e: self._drain_records(
+                getattr(e, "out_records", [])))
+        if err is None:
+            return records
+        last_err = err
+        trips = getattr(last_err, "trips", None) or []
+        ring_trip = trips and all(t.site_label == "inject"
+                                  for t in trips)
+        live = [r for r in self.active.values() if not r.done]
+        if ring_trip or not live:
+            raise last_err
+        victim = max(live, key=lambda r: r.admit_seq)
+        self._quarantine_resident(victim, last_err)
+        return None
+
+    def _quarantine_resident(self, req: Request, err) -> None:
+        """Quarantine, resident form: the client unblocks NOW (stream
+        closes, state FAILED) but the slot and pages stay held until
+        the device confirms the injected retirement — the device may
+        touch the slot's pages until its record is consumed."""
+
+        def retire():
+            self._end_phase(req)
+            req._finish(f"quarantined: {err!r}", RequestState.FAILED)
+            if self.worker.can_inject():
+                req._retire_sent = True
+                self.worker.retire(req.slot, req.request_id)
+            else:
+                # ring full right now — _reap_cancelled_resident
+                # retries (the FAILED state marks the lane as wanting
+                # retirement)
+                req._retire_sent = False
+
+        self._do_quarantine(req, err, retire)
+
+    def _do_quarantine(self, req: Request, err, retire) -> None:
+        """Shared quarantine bookkeeping (span, counter, flight dump);
+        `retire` is the mode-specific middle — host-loop retires the
+        lane immediately, resident injects a device retirement."""
+        now = time.perf_counter_ns()
+        self._spans.append((f"req{req.request_id}/quarantined", now, now))
+        self.quarantined.append(req)
+        self.obs.inc("serve_quarantined")
+        retire()
+        self.recorder.record(registry=self.obs,
+                             scheduler_state=self._state_summary(),
+                             error=err, step=self.worker.n_steps)
+        try:
+            self.last_flight_dump = self.recorder.dump(
+                reason=f"quarantine req{req.request_id}: {err!r}"[:200])
+        except OSError:
+            pass  # an unwritable dump dir must not kill the batch
+
+    def _drain_records(self, records) -> None:
+        """Fold the window's output records back into request state, in
+        device seq order — emissions stream through the detokenizer
+        exactly like host-loop emissions; retirements release the slot
+        and its pages. The device's eos/length decision is cross-
+        checked against the host recomputation (drift between the two
+        would be a contract break, not a policy choice)."""
+        from triton_dist_tpu.mega.ring import (
+            REASON_EOS,
+            REASON_LENGTH,
+        )
+
+        for rec in records:
+            if rec.emitted or rec.retired:
+                # first emission = prefill done (the device no longer
+                # streams from the admission row); retirement likewise
+                # — either way the pinned ring row is reclaimable
+                self.worker.unpin(rec.req_id)
+            req = self.active.get(rec.slot)
+            if req is None or req.request_id != rec.req_id:
+                continue  # stale record for a slot already turned over
+            if rec.emitted and not req.done:
+                # a done request (quarantined/cancelled with the retire
+                # record still pending) may keep stepping on-device for
+                # a window; its stream is closed — dropping the stale
+                # emission here keeps the TokenStream end-of-stream
+                # sentinel terminal
+                if req.state is RequestState.PREFILL:
+                    self._phase(req, "decode")
+                    req.state = RequestState.DECODE
+                req.last_active_step = self.worker.n_steps
+                piece = (self.detok.piece(rec.token)
+                         if self.detok else None)
+                req._emit(rec.token, piece)
+                self.obs.inc("serve_tokens_out")
+                would_retire = (
+                    (req.eos_id is not None and rec.token == req.eos_id)
+                    or len(req.out_tokens) >= req.max_new_tokens)
+                assert would_retire == rec.retired, (
+                    f"device retirement decision diverged from host "
+                    f"policy on req{req.request_id}: {rec}")
+            if rec.retired:
+                if req.done:
+                    # quarantined/cancel-finished earlier: the record
+                    # is the device's confirmation — free the lane
+                    self.pool.release(rec.slot)
+                    del self.active[rec.slot]
+                    req.slot = -1
+                    continue
+                if rec.reason == REASON_EOS:
+                    self._retire(req, "eos", RequestState.FINISHED)
+                elif rec.reason == REASON_LENGTH:
+                    self._retire(req, "length", RequestState.FINISHED)
+                else:  # REASON_HOST: an injected cancel came back
+                    self._retire(req, "cancelled",
+                                 RequestState.CANCELLED)
 
     def _count_guard_trips(self, err) -> None:
         """Guard-trip counters by wait site (the decoded rows a
@@ -328,19 +599,10 @@ class Scheduler:
         scheduler state, and the decoded guard rows of the fatal error
         — so the trip arrives with its context (docs/observability.md
         "Flight recorder")."""
-        now = time.perf_counter_ns()
-        self._spans.append((f"req{req.request_id}/quarantined", now, now))
-        self.quarantined.append(req)
-        self.obs.inc("serve_quarantined")
-        self._retire(req, f"quarantined: {err!r}", RequestState.FAILED)
-        self.recorder.record(registry=self.obs,
-                             scheduler_state=self._state_summary(),
-                             error=err, step=self.worker.n_steps)
-        try:
-            self.last_flight_dump = self.recorder.dump(
-                reason=f"quarantine req{req.request_id}: {err!r}"[:200])
-        except OSError:
-            pass  # an unwritable dump dir must not kill the batch
+        self._do_quarantine(
+            req, err,
+            lambda: self._retire(req, f"quarantined: {err!r}",
+                                 RequestState.FAILED))
 
     def run(self, max_steps: int = 100_000) -> None:
         """Drive steps until queue and slots drain."""
@@ -472,6 +734,11 @@ class Scheduler:
         out["active_slots"] = len(self.active)
         out["pool_free_pages"] = self.pool.free_pages()
         out["pool_used_pages"] = self.pool.used_pages()
+        if self.resident:
+            out["resident_windows"] = snap.get(
+                "serve_resident_windows", 0)
+            out["resident_steps"] = snap.get("serve_resident_steps", 0)
+            out["ring_depth"] = self.worker.pending_records()
         if self.slo is not None and self.slo.last is not None:
             out["health"] = self.slo.last.to_dict()
         return out
