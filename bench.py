@@ -56,7 +56,11 @@ fault is as broken as one that never trips).
 always-on stat-row tier — `obs_overhead_frac` (metered/plain ag_gemm
 chain time - 1) HARD-ASSERTED < 0.03, plus `obs_stat_events` (the
 metered run's decoded event total, asserted > 0: a meter that records
-nothing is as broken as one that taxes the kernel).
+nothing is as broken as one that taxes the kernel). Request tagging
+(ISSUE 13) rides the same build flag with ZERO kernel surface — the
+per-request ledger is host bookkeeping and the resident-window rows
+are pure-jnp streams — so the gate's ceiling covers the whole
+always-on tier with tagging active.
 """
 
 import json

@@ -10,15 +10,22 @@ decode steps share the same jit'd step, and tokens stream back over the
 socket as they are generated.
 
 Protocol (JSON lines): request {"ids": [[...]], "gen_len": N}; the
-server streams {"tok": t} per generated token, then {"gen": [[...]]}.
-Errors keep the envelope contract: one {"error": ...} line, so the
-client never hangs on a server fault.
+server streams {"tok": t, "req": id} per generated token — `req` is
+the scheduler's request trace id, the SAME id the request ledger,
+per-request Perfetto tracks, and flight-recorder state carry (ISSUE
+13), so a client-side latency complaint names the exact server-side
+attribution row — then {"gen": [[...]], "req": id}. Errors keep the
+envelope contract: one {"error": ...} line, so the client never hangs
+on a server fault.
 
 Observability (docs/observability.md): the literal line `/metrics`
 (or {"op": "metrics"}) answers with the scheduler registry's
 Prometheus text exposition and closes — a scrape endpoint riding the
-same socket, serving the TTFT/TPOT histograms, queue/pool gauges, and
-policy counters the scheduler streams while it batches.
+same socket, serving the TTFT/TPOT histograms, the per-request
+latency-DECOMPOSITION histograms (serve_req_queued_us /
+serve_req_prefill_us / serve_req_decode_us — where each retired
+request's wall time went), queue/pool gauges, and policy counters the
+scheduler streams while it batches.
 
 Run:  python examples/11_model_server.py [--tpu]
 """
@@ -80,9 +87,11 @@ def serve(sock, sch):
                                max_new_tokens=req.get("gen_len", GEN),
                                stream=True)
                 for tok, _piece in r.stream:  # streams as the batch runs
-                    f.write(json.dumps({"tok": tok}) + "\n")
+                    f.write(json.dumps({"tok": tok,
+                                        "req": r.request_id}) + "\n")
                     f.flush()
-                f.write(json.dumps({"gen": [r.out_tokens]}) + "\n")
+                f.write(json.dumps({"gen": [r.out_tokens],
+                                    "req": r.request_id}) + "\n")
             except Exception as e:  # surface to the client
                 import traceback
 
@@ -100,21 +109,26 @@ def serve(sock, sch):
 
 def chat(port, prompt, gen_len=GEN):
     """Chat-client leg (ref chat.py): send one prompt, consume the token
-    stream, return (streamed tokens, final gen line)."""
+    stream, return (streamed tokens, final gen line, request trace id).
+    Every envelope of one generation must carry the SAME trace id —
+    that id keys the server-side request ledger row."""
     c = socket.create_connection(("localhost", port))
     with c:
         f = c.makefile("rw")
         f.write(json.dumps({"ids": prompt, "gen_len": gen_len}) + "\n")
         f.flush()
-        streamed = []
+        streamed, rid = [], None
         while True:
             resp = json.loads(f.readline())
             if "error" in resp:
                 raise RuntimeError(resp["error"])
+            assert "req" in resp, f"envelope lost the trace id: {resp}"
+            assert rid in (None, resp["req"]), (rid, resp)
+            rid = resp["req"]
             if "tok" in resp:
                 streamed.append(resp["tok"])
             else:
-                return streamed, resp["gen"][0]
+                return streamed, resp["gen"][0], rid
 
 
 def main():
@@ -145,10 +159,17 @@ def main():
         th.start()
     for th in threads:
         th.join(timeout=120)
+    rids = set()
     for i, prompt in enumerate(prompts):
-        streamed, final = results[i]
+        streamed, final, rid = results[i]
         assert streamed == final and len(final) == GEN
-        print(f"11 model server: prompt {prompt[0]} -> streamed {streamed}")
+        rids.add(rid)
+        print(f"11 model server: prompt {prompt[0]} -> streamed "
+              f"{streamed} (req {rid})")
+    assert len(rids) == 2  # distinct requests, distinct trace ids
+    # the trace ids key the server-side request ledger rows
+    ledger_ids = {row["request_id"] for row in sch.ledger()["requests"]}
+    assert rids <= ledger_ids, (rids, ledger_ids)
     # the two requests really were batched: a serial server would need
     # 2 * (1 prefill chunk + 6 decode) = 14 steps
     assert sch.worker.n_steps < 14, (
@@ -165,6 +186,14 @@ def main():
         text = f.read()
     assert "serve_tokens_out_total" in text and \
         "serve_ttft_us_count" in text, text[:400]
+    # the per-request latency-decomposition histograms (ISSUE 13) ride
+    # the same scrape: one observation per retired request
+    for name in ("serve_req_queued_us", "serve_req_prefill_us",
+                 "serve_req_decode_us"):
+        count = [ln for ln in text.splitlines()
+                 if ln.startswith(f"{name}_count")]
+        assert count and int(float(count[0].split()[-1])) == 2, (
+            name, count)
     n_tok = [ln for ln in text.splitlines()
              if ln.startswith("serve_tokens_out_total")]
     assert n_tok and int(n_tok[0].split()[-1]) == 2 * GEN, n_tok

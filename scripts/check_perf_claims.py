@@ -138,6 +138,31 @@ def collect_claims(repo: str):
     return out
 
 
+def artifact_series(repo: str, strict: bool = False):
+    """Every BENCH_r*.json in round order (oldest first) as
+    (label, round, parsed) triples — THE artifact reader, shared
+    between the claims lint below and the perf-trend sentinel
+    (triton_dist_tpu/obs/trend.py + scripts/perf_trend.py), so the two
+    tools can never disagree about what an artifact says. Artifacts
+    without a parsed dict (round 1 predates the schema) are skipped;
+    unreadable JSON is skipped here and a ValueError under `strict`
+    (the sentinel's malformed-input contract)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        label = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            if strict:
+                raise ValueError(f"{label}: unreadable artifact: {e}")
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if isinstance(parsed, dict):
+            out.append((label, _artifact_round(label), parsed))
+    return out
+
+
 def latest_measured(repo: str):
     """(label, {key: (value, source_label)}) over BENCH_r*.json newest
     first, then BASELINE.json["published"]. Per KEY the newest artifact
@@ -145,17 +170,8 @@ def latest_measured(repo: str):
     back to the last round that measured it, so a claim never silently
     detaches from measurement just because the newest run dropped the
     field. Returns (None, {}) when no artifact exists at all."""
-    sources = []
-    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
-                       reverse=True):
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            continue
-        parsed = doc.get("parsed")
-        if isinstance(parsed, dict):
-            sources.append((os.path.basename(path), parsed))
+    sources = [(label, parsed)
+               for label, _rnd, parsed in reversed(artifact_series(repo))]
     base = os.path.join(repo, "BASELINE.json")
     try:
         with open(base) as f:

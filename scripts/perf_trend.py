@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""perf_trend — the perf-trend regression sentinel over the committed
+BENCH_r*.json / MULTICHIP_r*.json artifact series (ISSUE 13).
+
+Where `check_perf_claims.py` lints each prose claim against the newest
+artifact carrying its key, this tool reads the WHOLE series rig-aware
+(per-key newest-wins within a rig; `parsed.cpu_incomparable` keys
+quarantined) and flags trend regressions, watermark breaks,
+band violations/drift, missing metric families, and MULTICHIP state
+going backwards — see triton_dist_tpu/obs/trend.py for the rules and
+the ACKNOWLEDGED ledger.
+
+Usage:
+    python scripts/perf_trend.py [--out DIR] [--json] [-q]
+
+Writes (under --out, default ./perf-trend):
+    report.md     the markdown report (committed as docs/perf_trend.md
+                  each round — the PR's evidence)
+    report.json   the structured report (magic tdt-perf-trend;
+                  `scripts/trace_report.py --trend report.json`
+                  renders it)
+
+Exit codes (CI contract — wired into .github/workflows/ci.yml):
+  0  no flags, or every flag acknowledged in trend.ACKNOWLEDGED
+  1  at least one UNacknowledged regression flag
+  2  usage error / malformed artifact (strict parse)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable from anywhere: the repo root is the package root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from triton_dist_tpu.obs import trend  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default="perf-trend",
+                    help="report output directory (default ./perf-trend)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON report to stdout instead of "
+                         "the markdown")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    ap.add_argument("--repo", default=_REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    try:
+        report = trend.analyze(repo=args.repo, strict=True)
+    except ValueError as e:
+        print(f"perf_trend: malformed artifact: {e}", file=sys.stderr)
+        return 2
+
+    md = trend.render_markdown(report)
+    os.makedirs(args.out, exist_ok=True)
+    md_path = os.path.join(args.out, "report.md")
+    json_path = os.path.join(args.out, "report.json")
+    with open(md_path, "w", encoding="utf-8") as f:
+        f.write(md)
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+
+    if not args.quiet:
+        print(json.dumps(report, indent=1) if args.json else md)
+    unack = trend.unacknowledged(report)
+    s = report["summary"]
+    print(f"perf_trend: {s['n_series']} series, {s['n_flags']} flag(s) "
+          f"({len(unack)} unacknowledged), {s['n_notes']} note(s) -> "
+          f"{md_path}", file=sys.stderr)
+    for f in unack:
+        print(f"perf_trend: UNACKNOWLEDGED {f['kind']}: {f['key']} "
+              f"[{f['rig']}]: {f['detail']}", file=sys.stderr)
+    return 1 if unack else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

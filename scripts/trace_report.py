@@ -1,11 +1,14 @@
 #!/usr/bin/env python
 """trace_report — per-region attribution + predicted-stall diff from an
-exported trace JSON, and (--metrics) registry-snapshot / flight-dump
-rendering for the always-on tier.
+exported trace JSON, with render modes for the other observability
+artifacts: --metrics (registry snapshots / flight dumps), --requests
+(per-request ledgers), --trend (perf-trend sentinel reports).
 
 Usage:
     python scripts/trace_report.py TRACE.json [TRACE2.json ...]
     python scripts/trace_report.py --metrics SNAP_OR_DUMP.json [...]
+    python scripts/trace_report.py --requests LEDGER.json [...]
+    python scripts/trace_report.py --trend REPORT.json [...]
 
 Default mode reads Perfetto/Chrome-trace JSONs written by
 `trace.write_trace` (examples/12_trace_overlap.py, `bench.py --trace`),
@@ -25,7 +28,14 @@ renders them in the same table style: counters/gauges/histogram
 quantiles for a snapshot; the per-step ring (metric deltas, scheduler
 state, decoded guard rows) for a dump.
 
-Exits non-zero on a malformed input in BOTH modes (missing magic tag,
+`--requests` renders a per-request attribution ledger
+(`trace.write_ledger`, magic "tdt-req-ledger"; ISSUE 13): one row per
+request — queued / inject-wait / prefill / decode decomposition, the
+close fraction, device-step share. `--trend` renders a perf-trend
+sentinel report (`scripts/perf_trend.py --out`'s report.json, magic
+"tdt-perf-trend"): the flags/notes tables plus the multi-point series.
+
+Exits non-zero on a malformed input in EVERY mode (missing magic tag,
 torn histograms, dump snapshots without their guard-row lists) — the
 bench.check_result strictness contract: a tool that silently renders a
 clobbered artifact would hide exactly the bugs it exists to catch.
@@ -179,26 +189,77 @@ def report_metrics(path: str) -> None:
     print()
 
 
+def report_requests(path: str) -> None:
+    """Render one per-request ledger document (ISSUE 13; written by
+    trace.write_ledger / Scheduler.ledger). ValueError on malformed
+    input -> exit 1 in main."""
+    from triton_dist_tpu.trace.ledger import (
+        check_close,
+        format_requests_table,
+        load_ledger,
+    )
+
+    doc = load_ledger(path)
+    print(f"== {path} (request ledger: {len(doc['requests'])} "
+          f"request(s), mode={doc.get('mode', '?')}, "
+          f"chunk={doc.get('chunk', '?')}) ==")
+    print(format_requests_table(doc))
+    problems = check_close(doc)
+    for p in problems:
+        print(f"  CLOSE VIOLATION: {p}")
+    if problems:
+        raise ValueError(f"{path}: {len(problems)} request(s) fail the "
+                         "ledger close contract")
+    print()
+
+
+def report_trend(path: str) -> None:
+    """Render one perf-trend sentinel report (scripts/perf_trend.py
+    --out report.json). ValueError on malformed input -> exit 1."""
+    import json
+
+    from triton_dist_tpu.obs.trend import check_report, render_markdown
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: {e}") from e
+    check_report(doc)
+    print(f"== {path} (perf-trend sentinel report) ==")
+    print(render_markdown(doc))
+    print()
+
+
+_MODES = {
+    "--metrics": report_metrics,
+    "--requests": report_requests,
+    "--trend": report_trend,
+}
+
+
 def main(argv) -> int:
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
-    metrics_mode = "--metrics" in argv
-    paths = [a for a in argv if a != "--metrics"]
+    picked = [m for m in _MODES if m in argv]
+    if len(picked) > 1:
+        print(f"trace_report: pick one mode, got {picked}",
+              file=sys.stderr)
+        return 2
+    render = _MODES[picked[0]] if picked else report
+    paths = [a for a in argv if a not in _MODES]
     if not paths:
         print(__doc__, file=sys.stderr)
         return 2
     try:
         for path in paths:
-            if metrics_mode:
-                report_metrics(path)
-            else:
-                report(path)
+            render(path)
     except MalformedTrace as e:
         print(f"trace_report: malformed trace: {e}", file=sys.stderr)
         return 1
     except ValueError as e:
-        print(f"trace_report: malformed metrics artifact: {e}",
+        print(f"trace_report: malformed artifact: {e}",
               file=sys.stderr)
         return 1
     return 0

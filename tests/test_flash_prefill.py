@@ -87,7 +87,12 @@ def _run_sp(fn, mesh, q, k, v, out_specs=None):
     ))(q, k, v)
 
 
-@pytest.mark.parametrize("n", [2, 4, 8])
+# n=8 is slow-marked (tier-1 wall budget): the bitwise-vs-plain-
+# transport property is pinned at n=2/4 and the dryrun plane runs
+# sp_flash_prefill at n=4 — the 8-rank variant adds ring breadth the
+# verifier already proves at n=8 statically (deep runs keep it)
+@pytest.mark.parametrize("n", [2, 4,
+                               pytest.param(8, marks=pytest.mark.slow)])
 def test_sp_flash_prefill_bitwise_vs_plain_transport(n):
     """The overlapped per-segment-semaphore kernel is BIT-IDENTICAL to
     flash_prefill_ref (XLA gather + the same swizzle-order fold) at

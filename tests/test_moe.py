@@ -406,7 +406,14 @@ def test_chunk_group_sizes_partitions_segments():
         total[:, 3], cap - np.asarray(counts).sum(-1))
 
 
-@pytest.mark.parametrize("n_chunks", [1, 2, 4, None])
+# n_chunks=1 and 4 are slow-marked (tier-1 wall budget): the bitwise
+# overlap-vs-sequential property is pinned at n_chunks=2 and at the
+# chooser default (None) here, and the dryrun plane exercises the
+# overlapped EP step end to end — the 1/4 variants add chunk-count
+# breadth, not a distinct property (deep runs keep them)
+@pytest.mark.parametrize("n_chunks", [
+    pytest.param(1, marks=pytest.mark.slow), 2,
+    pytest.param(4, marks=pytest.mark.slow), None])
 def test_ep_moe_overlap_matches_sequential(mesh8, n_chunks):
     """The chunk-pipelined path must (a) be BIT-identical to its own
     sequential execution — same math behind the plain wait-everything

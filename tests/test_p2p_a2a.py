@@ -204,7 +204,12 @@ def test_all_to_all_chunked_fallback_mode(mesh8, monkeypatch):
     np.testing.assert_array_equal(np.asarray(osp), np.asarray(ref_sp))
 
 
-@pytest.mark.parametrize("skew_rank", [2, 5])
+# skew_rank=5 is slow-marked (tier-1 wall budget): the skew-visibility
+# replay is rank-symmetric by construction (delivery edges key on the
+# OFFSET, not the absolute rank — the PR-2 slot rule) so one straggler
+# position pins the property; deep runs keep the second position
+@pytest.mark.parametrize("skew_rank", [
+    2, pytest.param(5, marks=pytest.mark.slow)])
 def test_all_to_all_chunked_skew_visibility(mesh8, skew_rank):
     """ISSUE-3 satellite: a trace-enabled chunked A2A under
     straggler_delay must make the skew ATTRIBUTABLE — the delayed rank's

@@ -151,6 +151,30 @@ def decode_out_ring(buf, count: int) -> List[OutRecord]:
     return out
 
 
+def summarize_records(records) -> dict:
+    """Per-request roll-up of drained output records — the output-ring
+    metadata leg of the request ledger (ISSUE 13, trace/ledger.py):
+
+        {req_id: {"emits": n, "first_step": s0, "last_step": s1,
+                  "retired": bool, "reason": REASON_* | 0}}
+
+    `first_step`/`last_step` bound the request's device-step footprint
+    in the drained window(s); `emits` counts its sampled tokens."""
+    out: dict = {}
+    for r in records:
+        d = out.setdefault(r.req_id, {
+            "emits": 0, "first_step": r.step, "last_step": r.step,
+            "retired": False, "reason": 0})
+        d["first_step"] = min(d["first_step"], r.step)
+        d["last_step"] = max(d["last_step"], r.step)
+        if r.emitted:
+            d["emits"] += 1
+        if r.retired:
+            d["retired"] = True
+            d["reason"] = r.reason
+    return out
+
+
 # -- host producer ------------------------------------------------------------
 
 

@@ -358,11 +358,34 @@ class Engine:
         emits: both paths compile `_serve_step_math` and the device
         plan assembly (`mega.ring.slot_plan`) reproduces the host
         scheduler's per-step inputs field for field, including the
-        fold_in(PRNGKey(seed), n_out) sampling-key stream."""
+        fold_in(PRNGKey(seed), n_out) sampling-key stream.
+
+        Telemetry (ISSUE 13, docs/observability.md "Request-scoped
+        attribution"): a loop constructed under `trace.building()`
+        returns one extra trailing output — a pure-jnp mark stream of
+        serve.step spans (payload=device step, aux=active-slot mask)
+        plus serve.poll / serve.idle instants; under
+        `obs.stats.building()` one more — the (1 + slots, 1,
+        STAT_WORDS) resident-window stat rows (obs.stats.WMAGIC: loop
+        lane + one lane per slot), OUTERMOST last (the stats-then-trace
+        strip order). Both are data-independent integer streams: tokens
+        stay bitwise identical with telemetry on, and the bare loop's
+        program is untouched (zero-cost-off, tier-1-pinned)."""
+        from triton_dist_tpu.obs import stats as _ost
+        from triton_dist_tpu.trace import events as _tev
+
         prompt_cap = prompt_cap if prompt_cap is not None \
             else max_pages * page
+        # the build contexts are consulted when the loop is CONSTRUCTED
+        # (the trace/obs discipline) — a loop built under
+        # trace.building()/obs.stats.building() returns extra trailing
+        # telemetry outputs, so it must never share an executable with
+        # the bare loop
+        _tb = _tev.active_build()
+        _ob = _ost.active_build()
         key = ("resident", slots, chunk, page, max_pages, window,
-               ring_cap, prompt_cap, poll_budget)
+               ring_cap, prompt_cap, poll_budget,
+               _tb.cap if _tb is not None else -1, _ob is not None)
         fn = self._serve_cache.pop(key, None)
         if fn is None:
             fn = self._build_resident_loop(slots, chunk, page, max_pages,
@@ -377,6 +400,8 @@ class Engine:
                              max_pages: int, window: int, ring_cap: int,
                              prompt_cap: int, poll_budget: int):
         from triton_dist_tpu.mega import ring as mring
+        from triton_dist_tpu.obs import stats as _ost
+        from triton_dist_tpu.trace import events as _tev
 
         cfg = self.cfg
         mode = self.decode_mode
@@ -384,6 +409,13 @@ class Engine:
         t_pool = max_pages * page
         self._check_serve_geometry(slots, chunk, page, max_pages)
         assert window >= 1 and ring_cap >= 2 and poll_budget >= 1
+        tb_build = _tev.active_build()
+        ob_build = _ost.active_build()
+        # serve.step aux carries the active-slot BITMASK, so traced
+        # builds need every slot lane to fit an i32
+        assert tb_build is None or slots <= 30, (
+            f"traced resident loop supports <= 30 slots (got {slots}): "
+            "the serve.step active mask is one i32")
         # worst case: every step emits on every slot, plus one token-
         # less retirement record per injection-ring retire
         out_cap = window * slots + ring_cap
@@ -407,8 +439,20 @@ class Engine:
             out_ring0 = jnp.zeros((out_cap + 1, mring.OR_WIDTH),
                                   jnp.int32)
             slot_ids = jnp.arange(slots, dtype=jnp.int32)
+            # telemetry carried through the loop — trace-time gated, so
+            # the bare build's carry (and program) is exactly the
+            # untelemetered one. All entries are data-independent
+            # integer streams: they never feed the step math.
+            aux0 = {}
+            if tb_build is not None:
+                aux0["t"] = _tev.new_stream(tb_build, stream=0, rank=0)
+            if ob_build is not None:
+                zk = jnp.zeros((slots,), jnp.int32)
+                aux0.update(polls=jnp.int32(0), idlep=jnp.int32(0),
+                            s_steps=zk, s_idle=zk, s_emits=zk)
 
-            def boundary(executed, consumed, ss, tb, ln, out, n_out):
+            def boundary(executed, consumed, ss, tb, ln, out, n_out,
+                         aux):
                 """Step boundary: drain visible injection records and
                 report host-forced retirements out."""
                 step = step0 + executed
@@ -420,11 +464,18 @@ class Engine:
                     jnp.full((slots,), mring.FLAG_RETIRED, jnp.int32),
                     jnp.full((slots,), mring.REASON_HOST, jnp.int32),
                     ss[:, mring.SS_REQID])
-                return consumed2, ss, tb, ln, out, n_out
+                if tb_build is not None:
+                    aux = dict(aux, t=_tev.mark(
+                        aux["t"], _tev.REGIONS["serve.poll"],
+                        payload=consumed2 - consumed,
+                        aux=published - consumed2))
+                if ob_build is not None:
+                    aux = dict(aux, polls=aux["polls"] + 1)
+                return consumed2, ss, tb, ln, out, n_out, aux
 
             def cond(carry):
                 (executed, consumed, idle, ss, tb, ln, pk, pv, out,
-                 n_out) = carry
+                 n_out, aux) = carry
                 any_active = jnp.any(ss[:, mring.SS_ACTIVE] > 0)
                 pending = consumed < published
                 return (executed < window) & (
@@ -432,13 +483,20 @@ class Engine:
 
             def body(carry):
                 (executed, consumed, idle, ss, tb, ln, pk, pv, out,
-                 n_out) = carry
-                consumed2, ss, tb, ln, out, n_out = boundary(
-                    executed, consumed, ss, tb, ln, out, n_out)
+                 n_out, aux) = carry
+                consumed2, ss, tb, ln, out, n_out, aux = boundary(
+                    executed, consumed, ss, tb, ln, out, n_out, aux)
                 any_active = jnp.any(ss[:, mring.SS_ACTIVE] > 0)
 
-                def run_step(ss, tb, ln, pk, pv, out, n_out):
+                def run_step(ss, tb, ln, pk, pv, out, n_out, aux):
                     step = step0 + executed
+                    active = ss[:, mring.SS_ACTIVE] > 0
+                    if tb_build is not None:
+                        mask = jnp.sum(jnp.where(
+                            active, jnp.int32(1) << slot_ids, 0))
+                        aux = dict(aux, t=_tev.mark(
+                            aux["t"], _tev.REGIONS["serve.step"],
+                            _tev.KIND_BEGIN, payload=step, aux=mask))
                     tokens, n_valid, temps, keys, emits = \
                         mring.slot_plan(ring, ss, chunk, max_pages)
                     tok, _last, pk, pv = _serve_step_math(
@@ -479,34 +537,73 @@ class Engine:
                     out, n_out = scatter_out(
                         out, n_out, step, emits_i, slot_ids, tok,
                         flags, reasons, ss[:, mring.SS_REQID])
-                    return 1, ss, tb, ln, pk, pv, out, n_out
+                    if tb_build is not None:
+                        aux = dict(aux, t=_tev.mark(
+                            aux["t"], _tev.REGIONS["serve.step"],
+                            _tev.KIND_END, payload=step, aux=mask))
+                    if ob_build is not None:
+                        active_i = active.astype(jnp.int32)
+                        aux = dict(
+                            aux,
+                            s_steps=aux["s_steps"] + active_i,
+                            s_idle=aux["s_idle"] + 1 - active_i,
+                            s_emits=aux["s_emits"] + emits_i)
+                    return 1, ss, tb, ln, pk, pv, out, n_out, aux
 
-                def idle_step(ss, tb, ln, pk, pv, out, n_out):
-                    return 0, ss, tb, ln, pk, pv, out, n_out
+                def idle_step(ss, tb, ln, pk, pv, out, n_out, aux):
+                    if tb_build is not None:
+                        aux = dict(aux, t=_tev.mark(
+                            aux["t"], _tev.REGIONS["serve.idle"],
+                            payload=step0 + executed))
+                    return 0, ss, tb, ln, pk, pv, out, n_out, aux
 
-                stepped, ss, tb, ln, pk, pv, out, n_out = jax.lax.cond(
+                (stepped, ss, tb, ln, pk, pv, out, n_out,
+                 aux) = jax.lax.cond(
                     any_active, run_step, idle_step,
-                    ss, tb, ln, pk, pv, out, n_out)
+                    ss, tb, ln, pk, pv, out, n_out, aux)
+                if ob_build is not None:
+                    aux = dict(aux, idlep=aux["idlep"] + 1 - stepped)
                 progressed = (stepped > 0) | (consumed2 > consumed)
                 idle = jnp.where(progressed, 0, idle + 1)
                 return (executed + stepped, consumed2, idle, ss, tb,
-                        ln, pk, pv, out, n_out)
+                        ln, pk, pv, out, n_out, aux)
 
             carry = (jnp.int32(0), consumed0, jnp.int32(0), slot_state,
                      table, lengths, pool_k, pool_v, out_ring0,
-                     jnp.int32(0))
+                     jnp.int32(0), aux0)
             (executed, consumed, _idle, ss, tb, ln, pk, pv, out,
-             n_out) = jax.lax.while_loop(cond, body, carry)
+             n_out, aux) = jax.lax.while_loop(cond, body, carry)
             # a final boundary drain: records whose at_step gate opened
             # on the LAST executed step (e.g. a retire targeted at the
             # window's end) must not wait a whole extra window
-            consumed, ss, tb, ln, out, n_out = boundary(
-                executed, consumed, ss, tb, ln, out, n_out)
+            consumed, ss, tb, ln, out, n_out, aux = boundary(
+                executed, consumed, ss, tb, ln, out, n_out, aux)
             starved = mring.head_abandoned(
                 ring, published, consumed).astype(jnp.int32)
+            extras = ()
+            if tb_build is not None:
+                extras += (aux["t"],)
+            if ob_build is not None:
+                # the resident-window stat rows (obs/stats.py WMAGIC
+                # layout): loop lane first, then one lane per slot
+                i32 = jnp.int32
+                loop_row = jnp.stack([
+                    i32(_ost.WMAGIC), i32(-1), executed, aux["polls"],
+                    aux["idlep"], consumed - consumed0, starved,
+                    i32(0)])
+                slot_rows = jnp.stack([
+                    jnp.full((slots,), _ost.WMAGIC, jnp.int32),
+                    slot_ids, aux["s_steps"], aux["s_idle"],
+                    aux["s_emits"], ss[:, mring.SS_REQID],
+                    jnp.zeros((slots,), jnp.int32),
+                    jnp.zeros((slots,), jnp.int32)], axis=-1)
+                wrow = jnp.concatenate(
+                    [loop_row[None], slot_rows], 0)[:, None, :]
+                extras += (wrow,)
             return (consumed, executed, ss, tb, ln, pk, pv,
-                    out[:out_cap], n_out, starved)
+                    out[:out_cap], n_out, starved) + extras
 
+        n_extras = (tb_build is not None) + (ob_build is not None)
         pool_spec = P(None, self.axis)
         return jax.jit(
             jax.shard_map(
@@ -514,7 +611,7 @@ class Engine:
                 in_specs=((self._wrap_specs[0],) + (P(),) * 7
                           + (pool_spec, pool_spec)),
                 out_specs=((P(),) * 5 + (pool_spec, pool_spec)
-                           + (P(),) * 3),
+                           + (P(),) * (3 + n_extras)),
                 check_vma=False,
             ),
             donate_argnums=(8, 9) if self._donate_cache else (),

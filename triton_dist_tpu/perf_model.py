@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -915,6 +916,51 @@ def estimate_resident_step_ms(
         chip=chip, attn_impl=attn_impl)
     return (base + RESIDENT_POLL_US * 1e-3
             + SERVE_DISPATCH_US * 1e-3 / max(window, 1))
+
+
+# resident-window auto-sizing targets: the amortized dispatch tax the
+# chooser drives under (2% of the modeled step), and the window bounds
+# — at least 4 steps (below that the mode barely amortizes anything)
+# and at most 128 (the host must regain control for admission/cancel
+# latency within a bounded horizon)
+RESIDENT_WINDOW_TAX = 0.02
+RESIDENT_WINDOW_MIN = 4
+RESIDENT_WINDOW_MAX = 128
+
+
+def choose_resident_window(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    slots: int = 4,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+) -> int:
+    """Model-driven resident window (ROADMAP item 2 follow-up: drive
+    the window from `estimate_resident_step_ms` instead of a fixed 16):
+    the SMALLEST window whose amortized per-step dispatch tax
+    (SERVE_DISPATCH_US / window) is within RESIDENT_WINDOW_TAX of the
+    modeled step time. Small/fast steps (tiny shards, the tunnel rig's
+    ~90 ms RTT pricing in as dispatch) need deep windows; steps that
+    drown the dispatch keep the window shallow so admissions and
+    cancellations reach the device sooner — the same step-time axis
+    `choose_serve_mode` flips the MODE on, driving the DEPTH. Clamped
+    to [RESIDENT_WINDOW_MIN, RESIDENT_WINDOW_MAX]; monotone
+    non-increasing in the modeled step time (tests/test_serve_resident
+    pins both)."""
+    base_ms = estimate_serve_step_ms(
+        num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+        vocab_loc, n_tokens=max(slots, 1), kv_tokens=kv_tokens,
+        dtype=dtype, chip=chip, attn_impl=attn_impl)
+    want = int(math.ceil(
+        SERVE_DISPATCH_US * 1e-3 / (RESIDENT_WINDOW_TAX * base_ms)))
+    return max(RESIDENT_WINDOW_MIN, min(RESIDENT_WINDOW_MAX, want))
 
 
 def choose_serve_mode(

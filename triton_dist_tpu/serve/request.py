@@ -97,7 +97,20 @@ class Request:
     # -- metrics (perf_counter_ns) --------------------------------------
     t_submit: int = 0
     t_first_token: int = 0
+    t_finish: int = 0          # stamped by _finish (ledger wall clock)
     token_times: List[int] = dataclasses.field(default_factory=list)
+
+    # -- request-scoped attribution (ISSUE 13) --------------------------
+    # accumulated wall time per lifecycle phase (queued/prefill/decode;
+    # an evicted request re-accumulates queued+prefill) — the scheduler
+    # folds each closed phase span in here, so TTFT/TPOT decompose per
+    # request without replaying the span log (trace/ledger.py)
+    phase_ns: dict = dataclasses.field(default_factory=dict)
+    n_device_steps: int = 0    # serve steps this request rode
+    n_prefill_chunks: int = 0  # prefill chunk steps among them
+    n_windows: int = 0         # resident windows it was live in
+    inject_wait_ns: int = 0    # admit -> first window that consumed the
+    # request's injection record (resident mode; 0 on the host loop)
 
     def history(self) -> List[int]:
         return self.prompt + self.out_tokens
@@ -143,6 +156,7 @@ class Request:
     def _finish(self, reason: str, state: RequestState):
         self.state = state
         self.finish_reason = reason
+        self.t_finish = time.perf_counter_ns()
         if self.stream is not None:
             self.stream._close()
 

@@ -141,8 +141,26 @@ class Scheduler:
             ) == "resident"
         self.resident = bool(resident)
         if self.resident:
+            if window is None:
+                # chooser-backed auto-sizing (ROADMAP item 2 follow-up):
+                # the window comes from the resident step model — small
+                # steps need a deep window to amortize the dispatch tax,
+                # steps that drown it keep the window short so the host
+                # regains control (admission/cancel latency) sooner
+                from triton_dist_tpu.perf_model import (
+                    choose_resident_window,
+                )
+
+                cfg = engine.cfg
+                n = int(engine.mesh.shape[engine.axis])
+                window = choose_resident_window(
+                    cfg.num_layers, cfg.hidden_size,
+                    cfg.intermediate_size // n, cfg.num_q_heads // n,
+                    cfg.num_kv_heads // n, cfg.head_dim,
+                    cfg.vocab_size // n, slots=slots,
+                    kv_tokens=self.pool.t_max, dtype=cfg.dtype)
             self.worker = ResidentWorker(
-                engine, self.pool, chunk, window=window or 16,
+                engine, self.pool, chunk, window=window,
                 ring_cap=ring_cap)
         else:
             # under "auto" the chooser may legitimately pick the host
@@ -176,6 +194,23 @@ class Scheduler:
         self.obs = registry if registry is not None else Registry()
         self.obs.declare_histogram("serve_ttft_us", *LATENCY_BUCKETS)
         self.obs.declare_histogram("serve_tpot_us", *LATENCY_BUCKETS)
+        # per-request latency DECOMPOSITION (ISSUE 13): where each
+        # retired request's wall time went — streamed at retirement so
+        # the /metrics scrape carries the breakdown live
+        for name in ("serve_req_queued_us", "serve_req_prefill_us",
+                     "serve_req_decode_us"):
+            self.obs.declare_histogram(name, *LATENCY_BUCKETS)
+        # -- request-scoped attribution (ISSUE 13): per-step / per-
+        # window slot->request history, the substrate trace/ledger.py
+        # folds device time through. Bounded: a long-running server
+        # drops the oldest entries (counted) rather than growing
+        self.history: List[dict] = []
+        self.history_cap = 8192
+        self.history_dropped = 0
+        # requests whose injection record the device has not consumed
+        # yet (req_id -> Request) — the inject-wait stamp's worklist,
+        # kept tiny so _observe_window never scans self.requests
+        self._pending_inject: dict = {}
         self.recorder = recorder if recorder is not None \
             else FlightRecorder(cap=64)
         self.slo = slo
@@ -299,6 +334,7 @@ class Scheduler:
             self._observe_step()
             return True
 
+        step_idx = self.worker.n_steps
         toks = self._run_step(tokens, n_valid, temps, keys, plans)
         if toks is None:
             # step failed beyond its retry budget; the poisoning
@@ -306,10 +342,22 @@ class Scheduler:
             # unchanged pool state (Worker.step's failure contract)
             self._observe_step()
             return True
+        # history walls come from the SUCCESSFUL attempt only — retry
+        # walls and backoff sleeps must not inflate the ledger's
+        # device-time split (retries are separately visible as
+        # step/retryN spans + counters)
+        t0, t1 = self._attempt_span
+        self._record_history({
+            "kind": "step", "step": step_idx, "t0": t0, "t1": t1,
+            "slots": {s: (r.request_id, r.state.value, n)
+                      for s, r, n, _e in plans},
+        })
 
         for slot, req, n, emits in plans:
             req.last_active_step = self.worker.n_steps
+            req.n_device_steps += 1
             if req.state is RequestState.PREFILL:
+                req.n_prefill_chunks += 1
                 req.pos += n
                 if emits:
                     self._phase(req, "decode")
@@ -334,8 +382,14 @@ class Scheduler:
         for attempt in range(self.max_step_retries + 1):
             t0 = time.perf_counter_ns()
             try:
-                return body(), None
+                result = body()
+                # the ATTEMPT's own wall (no backoff sleeps, no earlier
+                # failed attempts) — what the ledger's device-time
+                # split may honestly call device time
+                self._attempt_span = (t0, time.perf_counter_ns())
+                return result, None
             except FaultError as e:
+                self._attempt_span = (t0, time.perf_counter_ns())
                 last_err = e
                 if on_fault is not None:
                     on_fault(e)
@@ -379,6 +433,13 @@ class Scheduler:
             return False
         t0 = time.perf_counter_ns()
         steps0 = self.worker.n_steps
+        window_idx = self.worker.n_windows
+        consumed0 = self.worker.ring.consumed
+        # slot occupants at window LAUNCH — the attribution snapshot
+        # (a slot that turns over mid-window is attributed to its
+        # launch occupant; docs/observability.md documents the
+        # tolerance)
+        slots_at_launch = dict(self.active)
         self.obs.set_gauge("serve_ring_depth",
                            self.worker.pending_records())
         records = self._run_window()
@@ -390,10 +451,59 @@ class Scheduler:
         executed = self.worker.n_steps - steps0
         if executed:
             self.obs.inc("serve_resident_steps", executed)
+        # the history entry's wall is the LAST launch attempt only (the
+        # span above keeps the full pump incl. retries/backoff — the
+        # two answer different questions)
+        w0, w1 = self._attempt_span
+        self._observe_window(window_idx, steps0, executed, w0, w1,
+                             consumed0, slots_at_launch)
         self.obs.set_gauge("serve_ring_depth_post",
                            self.worker.pending_records())
         self._observe_step()
         return True
+
+    def _observe_window(self, window_idx, step0, executed, t0, t1,
+                        consumed0, slots_at_launch) -> None:
+        """Window-level attribution bookkeeping: the history entry the
+        request ledger folds device time through, the decoded
+        resident-window stat rows (when the loop was built metered),
+        and the per-request window/inject-wait counters. O(slots +
+        pending admissions) per window — never a scan of the full
+        request log."""
+        from triton_dist_tpu.obs import stats as ostats
+
+        consumed1 = self.worker.ring.consumed
+        wstats = None
+        if self.worker.last_window_stats is not None:
+            wstats = ostats.decode_window_rows(
+                self.worker.last_window_stats)
+            ostats.record_window_stats(self.obs, wstats)
+        self._record_history({
+            "kind": "window", "window": window_idx, "step0": step0,
+            "executed": executed, "t0": t0, "t1": t1,
+            "consumed0": consumed0, "consumed1": consumed1,
+            "slots": {s: r.request_id
+                      for s, r in slots_at_launch.items()},
+            "stats": wstats,
+            "trace": self.worker.last_window_trace,
+        })
+        if executed:
+            for req in slots_at_launch.values():
+                req.n_windows += 1
+        for rid, req in list(self._pending_inject.items()):
+            if consumed1 >= req._admit_rec_seq:
+                # the device picked the admission up somewhere in this
+                # window: inject wait = admit -> this window's end (the
+                # per-window resolution the ring contract gives us)
+                req.inject_wait_ns = max(
+                    0, t1 - getattr(req, "_t_admit_ns", t1))
+                del self._pending_inject[rid]
+
+    def _record_history(self, entry: dict) -> None:
+        self.history.append(entry)
+        if len(self.history) > self.history_cap:
+            del self.history[0]
+            self.history_dropped += 1
 
     def _admit_resident(self) -> None:
         """Admission, resident form: a request needs a free slot and
@@ -438,6 +548,11 @@ class Scheduler:
             self.worker.admit(
                 slot, req.history(), req.max_new_tokens,
                 req.temperature, req.seed, req.eos_id, req.request_id)
+            # inject-wait bookkeeping (ISSUE 13): the record's seq, so
+            # _observe_window can stamp the admit -> device-pickup wait
+            req._t_admit_ns = time.perf_counter_ns()
+            req._admit_rec_seq = self.worker.ring.published
+            self._pending_inject[req.request_id] = req
 
     def _reap_cancelled_resident(self) -> None:
         """Cancellation, resident form: the retirement travels as a
@@ -553,6 +668,14 @@ class Scheduler:
                 if req.state is RequestState.PREFILL:
                     self._phase(req, "decode")
                     req.state = RequestState.DECODE
+                    # the full prefill ran on device: credit its chunk
+                    # steps now (resident mode never evicts, so the
+                    # history length here is exactly what was staged)
+                    chunks = -(-len(req.history()) // self.chunk)
+                    req.n_prefill_chunks += chunks
+                    req.n_device_steps += chunks
+                else:
+                    req.n_device_steps += 1
                 req.last_active_step = self.worker.n_steps
                 piece = (self.detok.piece(rec.token)
                          if self.detok else None)
@@ -739,6 +862,10 @@ class Scheduler:
                 "serve_resident_windows", 0)
             out["resident_steps"] = snap.get("serve_resident_steps", 0)
             out["ring_depth"] = self.worker.pending_records()
+            # metered loops (obs.stats.building at construction) fold
+            # the window rows' poll taxonomy in; 0 when unmetered
+            out["ring_polls"] = snap.get("serve_resident_ring_polls", 0)
+            out["idle_polls"] = snap.get("serve_resident_idle_polls", 0)
         if self.slo is not None and self.slo.last is not None:
             out["health"] = self.slo.last.to_dict()
         return out
@@ -751,6 +878,38 @@ class Scheduler:
 
         return Timeline(events=[], spans=[], drops={},
                         host_spans=list(self._spans), label="serve")
+
+    def ledger(self, tol: float = 0.05):
+        """The per-request attribution ledger (ISSUE 13): TTFT/TPOT
+        decomposed per retired request — queued / inject wait / prefill
+        / decode wall, device-step share, window counters — built from
+        the phase accumulators plus the slot history. See
+        trace/ledger.py for the close contract (phase sums vs wall
+        within `tol`)."""
+        from triton_dist_tpu.trace.ledger import build_ledger
+
+        return build_ledger(self, tol=tol)
+
+    def window_timeline(self):
+        """Assemble the resident windows' serve.* mark streams (loops
+        constructed under trace.building()) into one Timeline — one
+        stream per window, named serve.w<N>. Raises when no window
+        carried a trace (the loop was built untraced)."""
+        from triton_dist_tpu.trace import events as tev
+        from triton_dist_tpu.trace.collect import assemble
+
+        bufs = {
+            f"serve.w{e['window']}": np.asarray(e["trace"]).reshape(
+                1, -1, tev.RECORD_WORDS)
+            for e in self.history
+            if e.get("kind") == "window" and e.get("trace") is not None
+        }
+        if not bufs:
+            raise ValueError(
+                "no traced resident windows — construct the Scheduler "
+                "inside trace.building() to trace the loop")
+        return assemble(bufs, label="serve-resident",
+                        host_spans=list(self._spans))
 
     # -- internals ------------------------------------------------------
 
@@ -846,6 +1005,15 @@ class Scheduler:
         self.obs.observe("serve_ttft_us", req.ttft_us())
         if req.tpot_us() is not None:
             self.obs.observe("serve_tpot_us", req.tpot_us())
+        # the latency DECOMPOSITION histograms (ISSUE 13): where the
+        # retired request's wall time went, by lifecycle phase — the
+        # live form of the request ledger's phase columns
+        for phase, name in (("queued", "serve_req_queued_us"),
+                            ("prefill", "serve_req_prefill_us"),
+                            ("decode", "serve_req_decode_us")):
+            ns = req.phase_ns.get(phase)
+            if ns is not None:
+                self.obs.observe(name, ns / 1e3)
 
     def _retire(self, req: Request, reason: str, state) -> None:
         self.pool.release(req.slot)
@@ -870,8 +1038,12 @@ class Scheduler:
         ph = getattr(req, "_phase", None)
         if ph is not None:
             name, t0 = ph
-            self._spans.append((f"req{req.request_id}/{name}", t0,
-                                time.perf_counter_ns()))
+            now = time.perf_counter_ns()
+            self._spans.append((f"req{req.request_id}/{name}", t0, now))
+            # accumulate into the per-request phase ledger (ISSUE 13):
+            # an evicted request re-accumulates queued/prefill, so the
+            # sum over phases closes against submit->finish wall time
+            req.phase_ns[name] = req.phase_ns.get(name, 0) + (now - t0)
             req._phase = None
 
     def _phase(self, req: Request, name: str) -> None:

@@ -118,10 +118,23 @@ class ResidentWorker:
                                                         16)
         self.ring = mring.InjectionRing(cap, pool.max_pages, pool.t_max,
                                         chunk)
+        # the build contexts active NOW decide the loop's trailing
+        # telemetry outputs (the trace/obs construction-time
+        # discipline, ISSUE 13): a trace build adds the serve.* mark
+        # stream, an obs build the resident-window stat rows
+        from triton_dist_tpu.obs import stats as _ost
+        from triton_dist_tpu.trace import events as _tev
+
+        self._traced = _tev.active_build() is not None
+        self._metered = _ost.active_build() is not None
         self._fn = engine.make_resident_loop(
             pool.slots, chunk, pool.page, pool.max_pages, window,
             ring_cap=cap, prompt_cap=pool.t_max,
             poll_budget=poll_budget)
+        # newest window's telemetry (None until a window ran / when the
+        # matching build was off at construction)
+        self.last_window_stats = None
+        self.last_window_trace = None
         self.slot_state = np.zeros((pool.slots, mring.SS_WIDTH),
                                    np.int32)
         # the DEVICE's page-table/length view, installed by record
@@ -178,6 +191,12 @@ class ResidentWorker:
         after `max_stuck_windows` consecutive windows with zero
         progress (no step executed, no record consumed) while work is
         pending — the host-side bound on the device's ring poll."""
+        # reset the telemetry slots BEFORE any fault can fire: a window
+        # that raises pre-launch must not leave the PREVIOUS window's
+        # stats behind for the scheduler to re-fold (double-counted
+        # ring polls — the stale-stats class)
+        self.last_window_stats = None
+        self.last_window_trace = None
         plan = _fplan.active()
         if plan is not None:
             err = plan.step_fault(self.n_windows)
@@ -195,8 +214,7 @@ class ResidentWorker:
                 or self._ring_dev_version != self.ring.version:
             self._ring_dev = jnp.asarray(self.ring.buf)
             self._ring_dev_version = self.ring.version
-        (consumed, executed, ss, table, lengths, pool.k, pool.v,
-         out_ring, out_count, starved) = self._fn(
+        res = self._fn(
             self.engine.params,
             self._ring_dev,
             jnp.asarray(self.ring.published, jnp.int32),
@@ -207,6 +225,17 @@ class ResidentWorker:
             jnp.asarray(self._lengths),
             pool.k, pool.v,
         )
+        # strip the trailing telemetry outputs, stats outermost (the
+        # documented strip order): primary, trace mark stream, window
+        # stat rows
+        if self._metered:
+            self.last_window_stats = np.asarray(res[-1])
+            res = res[:-1]
+        if self._traced:
+            self.last_window_trace = np.asarray(res[-1])
+            res = res[:-1]
+        (consumed, executed, ss, table, lengths, pool.k, pool.v,
+         out_ring, out_count, starved) = res
         # fold the window's truth back in BEFORE any raise: the device
         # really ran `executed` steps — a retry must not replay them
         consumed = int(consumed)

@@ -72,3 +72,14 @@ from triton_dist_tpu.trace.export import (  # noqa: F401
     to_chrome_trace,
     write_trace,
 )
+from triton_dist_tpu.trace.ledger import (  # noqa: F401
+    LEDGER_MAGIC,
+    attribute_branch_time,
+    build_ledger,
+    check_close,
+    check_ledger,
+    format_requests_table,
+    load_ledger,
+    write_ledger,
+    write_request_trace,
+)

@@ -94,6 +94,12 @@ REGIONS = {
     "fault.inject": 22,  # host-side fault-injection instant (chaos
     # plane / scheduler quarantine markers ride host spans; this region
     # tags in-band injection points)
+    "serve.step": 23,    # resident-loop serve step (payload=device step,
+    # aux=active-slot bitmask — the slot lanes of the step, ISSUE 13)
+    "serve.poll": 24,    # resident-loop ring boundary drain (payload=
+    # records consumed at this boundary, aux=records still pending)
+    "serve.idle": 25,    # resident-loop idle poll (nothing active, ring
+    # pending but gated — payload=device step)
 }
 _REGION_NAMES = {v: k for k, v in REGIONS.items()}
 
@@ -113,6 +119,7 @@ REGION_CLASS = {
     "ep.ffn_chunk": "compute",
     "fp.wait": "sem_wait",
     "fp.fold": "compute",
+    "serve.step": "compute",
 }
 
 # ep.phase payload codes
