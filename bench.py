@@ -1137,6 +1137,132 @@ def bench_serve_resident(mesh, n_requests=8, prompt_len=96, gen_len=16,
     }
 
 
+def bench_serve_spec(mesh, n_requests=8, prompt_len=48, gen_len=32,
+                     qps_levels=(4.0, 32.0), spec_k=4, cfg=None,
+                     ctx=None):
+    """Speculative decoding vs the plain-decode arm at >= 2 QPS levels
+    (ISSUE 14): the SAME Poisson trace through a Scheduler(spec=
+    SpecConfig(k, NgramDraft)) and a plain one, on templated
+    (internally repetitive) prompts — the production chat shape the
+    self-drafting n-gram head exists for. Before any timing, a
+    submit-all pass asserts the spec arm's tokens BIT-IDENTICAL to the
+    plain arm's (the serve plane's acceptance oracle extends to the
+    artifact chain, like bench_serve_resident), and doubles as the
+    compile warmup for both executables.
+
+    `spec_vs_plain_tokens` is the headline throughput ratio at the hi
+    QPS level; `spec_accept_rate` (accepted/proposed over the spec
+    arm) is the quantity the k chooser consumes. Ratios are
+    link-robust on the cpu-world1 rig like the other serving families
+    (docs/performance.md "Rigs"); note the rig's random-weight decode
+    accepts only where greedy decode self-loops, so the measured rate
+    is a FLOOR for templated production traffic. cfg/ctx are
+    overridable for the reduced-geometry CPU rig."""
+    from triton_dist_tpu.serve import Scheduler
+    from triton_dist_tpu.spec import NgramDraft, SpecConfig
+
+    cfg = cfg or _shard_cfg()
+    ctx = ctx or CTX
+    eng = Engine(cfg, mesh, decode_mode="ar", max_len=ctx,
+                 fast_init=True)
+    SLOTS, CHUNK, PAGE = 4, 64, 64
+    rng = np.random.default_rng(31)
+    base = rng.integers(0, cfg.vocab_size, 8).tolist()
+    reps = -(-prompt_len // len(base))
+    prompts = [(base * reps)[:prompt_len - 1] + [int(t)]
+               for t in rng.integers(0, cfg.vocab_size, n_requests)]
+
+    def spec_cfg():
+        return SpecConfig(k=spec_k, draft=NgramDraft())
+
+    # bit-identity pass (also the compile warmup for both arms)
+    wsp = Scheduler(eng, slots=SLOTS, chunk=CHUNK, page=PAGE,
+                    spec=spec_cfg())
+    wpl = Scheduler(eng, slots=SLOTS, chunk=CHUNK, page=PAGE)
+    rsp = [wsp.submit(p, max_new_tokens=gen_len) for p in prompts]
+    rpl = [wpl.submit(p, max_new_tokens=gen_len) for p in prompts]
+    wsp.run()
+    wpl.run()
+    assert [r.out_tokens for r in rsp] == \
+        [r.out_tokens for r in rpl], (
+        "spec decode diverged bitwise from plain decode — the "
+        "throughput ratio below would be meaningless")
+
+    def run_arm(qps, spec):
+        sch = Scheduler(eng, slots=SLOTS, chunk=CHUNK, page=PAGE,
+                        spec=spec)
+        arrivals = np.cumsum(np.random.default_rng(37).exponential(
+            1.0 / qps, n_requests))
+        return drive_poisson(sch, prompts, arrivals, gen_len)
+
+    levels = {}
+    for qps in qps_levels:
+        levels[f"qps{qps:g}"] = {
+            "spec": run_arm(qps, spec_cfg()),
+            "plain": run_arm(qps, None),
+        }
+    hi = levels[f"qps{max(qps_levels):g}"]
+    proposed = hi["spec"]["spec_proposed"]
+    return {
+        "serve_spec_tokens_per_s": hi["spec"]["tokens_per_s"],
+        "serve_spec_plain_tokens_per_s": hi["plain"]["tokens_per_s"],
+        "spec_vs_plain_tokens": round(
+            hi["spec"]["tokens_per_s"]
+            / max(hi["plain"]["tokens_per_s"], 1e-9), 4),
+        "spec_accept_rate": round(
+            hi["spec"]["spec_accepted"] / proposed, 4
+        ) if proposed else 0.0,
+        "serve_spec_levels": levels,
+    }
+
+
+def bench_prefix_ttft(mesh, prompt_len=96, gen_len=4, pairs=5,
+                      cfg=None, ctx=None):
+    """Prefix-cache TTFT collapse (ISSUE 14): `pairs` distinct
+    templated prompts, each submitted COLD (miss — full prefill) then
+    HOT (radix hit — prefill skips the cached blocks) through one
+    Scheduler(prefix_cache=True). `prefix_hit_ttft_us` /
+    `prefix_cold_ttft_us` are medians over the pairs;
+    `prefix_hit_ttft` is their ratio (the TTFT fraction a templated
+    prompt still pays). Hot tokens are asserted bitwise equal to cold
+    tokens pair by pair — the bit-identity oracle in-arm."""
+    from triton_dist_tpu.serve import Scheduler
+
+    cfg = cfg or _shard_cfg()
+    ctx = ctx or CTX
+    eng = Engine(cfg, mesh, decode_mode="ar", max_len=ctx,
+                 fast_init=True)
+    SLOTS, CHUNK, PAGE = 4, 64, 64
+    sch = Scheduler(eng, slots=SLOTS, chunk=CHUNK, page=PAGE,
+                    prefix_cache=True, prefix_block=PAGE)
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(pairs)]
+    # warmup compile outside the timed pairs — a DEDICATED prompt, so
+    # it cannot seed the cache for the first "cold" pair
+    sch.submit(rng.integers(0, cfg.vocab_size, CHUNK).tolist(),
+               max_new_tokens=2)
+    sch.run()
+    cold_us, hot_us = [], []
+    for p in prompts:
+        a = sch.submit(p, max_new_tokens=gen_len)
+        sch.run()
+        b = sch.submit(p, max_new_tokens=gen_len)
+        sch.run()
+        assert b.out_tokens == a.out_tokens, (
+            "prefix-hit tokens diverged bitwise from the cold run")
+        assert b.prefix_len > 0, "second submission did not hit"
+        cold_us.append(a.ttft_us())
+        hot_us.append(b.ttft_us())
+    cold = float(np.median(cold_us))
+    hot = float(np.median(hot_us))
+    return {
+        "prefix_cold_ttft_us": round(cold, 2),
+        "prefix_hit_ttft_us": round(hot, 2),
+        "prefix_hit_ttft": round(hot / max(cold, 1e-9), 4),
+    }
+
+
 TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
 FAULTS_OVERHEAD_CEIL = 0.03  # hard guard on --faults watchdog cost
 OBS_OVERHEAD_CEIL = 0.03    # hard guard on --obs stat-row metering cost
@@ -1426,6 +1552,13 @@ _NUMERIC_KEYS = {
     "serve_resident_saturation_tokens_per_s",
     "serve_resident_window_steps",
     "serve_resident_ring_depth_max", "serve_resident_ring_depth_mean",
+    # spec decoding + radix prefix cache (ISSUE 14): spec vs plain
+    # decode at 2 QPS levels (bit-identity asserted in-arm) with the
+    # acceptance rate the k chooser consumes, and the hot/cold
+    # prefix-hit TTFT pair (keys travel together per family)
+    "serve_spec_tokens_per_s", "serve_spec_plain_tokens_per_s",
+    "spec_vs_plain_tokens", "spec_accept_rate",
+    "prefix_hit_ttft_us", "prefix_cold_ttft_us", "prefix_hit_ttft",
 }
 # the --faults keys travel together (an overhead claim without its trip
 # audit — or vice versa — is unfalsifiable from the artifact)
@@ -1467,7 +1600,7 @@ _AG_WIRE_KEYS = {"ag_gemm_wire_fp8_ms", "ag_gemm_wire_fp8_vs_native"}
 # noise-vs-regression question was unfalsifiable without them
 _OTHER_KEYS = {"raw", "mega_32b_raw", "prefill_raw", "prefill_s128_raw",
                "serve_levels", "sp_prefill_raw", "allreduce_wire_raw",
-               "serve_resident_raw"}
+               "serve_resident_raw", "serve_spec_levels"}
 # the resident-serving family travels together: the ratio without both
 # absolute arms, the saturation ceiling, or the ring-pressure stats
 # would be unfalsifiable from the artifact
@@ -1478,6 +1611,18 @@ _SERVE_RESIDENT_KEYS = {
     "serve_resident_saturation_tokens_per_s",
     "serve_resident_window_steps",
     "serve_resident_ring_depth_max", "serve_resident_ring_depth_mean",
+}
+# the spec-decode family travels together: the ratio without both
+# absolute arms or the acceptance rate (which explains the ratio) is
+# unfalsifiable; the per-level breakdown rides in serve_spec_levels
+_SERVE_SPEC_KEYS = {
+    "serve_spec_tokens_per_s", "serve_spec_plain_tokens_per_s",
+    "spec_vs_plain_tokens", "spec_accept_rate",
+}
+# the prefix-TTFT family likewise (a hit time without its cold arm —
+# or the ratio without either — is unfalsifiable)
+_PREFIX_KEYS = {
+    "prefix_hit_ttft_us", "prefix_cold_ttft_us", "prefix_hit_ttft",
 }
 
 
@@ -1563,6 +1708,36 @@ def check_result(result: dict) -> list:
             problems.append(
                 "faults_guard_trips must be 0 on the clean bench chain "
                 "(a guard tripping without a fault is broken)")
+    spec_present = _SERVE_SPEC_KEYS & set(result)
+    if spec_present:
+        for k in _SERVE_SPEC_KEYS - set(result):
+            problems.append(
+                f"serve-spec keys travel together: {k!r} missing "
+                f"while {sorted(spec_present)[0]!r} is present")
+        lv = result.get("serve_spec_levels")
+        if not isinstance(lv, dict) or len(lv) < 2:
+            problems.append(
+                "serve_spec_levels must carry >= 2 QPS levels beside "
+                "the serve_spec_* keys")
+        else:
+            for lvl, arms in lv.items():
+                for arm in ("spec", "plain"):
+                    stats = (arms or {}).get(arm)
+                    if not isinstance(stats, dict) \
+                            or "tokens_per_s" not in stats:
+                        problems.append(
+                            f"serve_spec_levels[{lvl!r}] missing the "
+                            f"{arm!r} arm's tokens_per_s")
+        rate = result.get("spec_accept_rate")
+        if isinstance(rate, (int, float)) and not 0 <= rate <= 1:
+            problems.append(
+                f"spec_accept_rate {rate!r} outside [0, 1]")
+    pfx_present = _PREFIX_KEYS & set(result)
+    if pfx_present:
+        for k in _PREFIX_KEYS - set(result):
+            problems.append(
+                f"prefix-ttft keys travel together: {k!r} missing "
+                f"while {sorted(pfx_present)[0]!r} is present")
     srv_res_present = _SERVE_RESIDENT_KEYS & set(result)
     if srv_res_present:
         for k in _SERVE_RESIDENT_KEYS - set(result):
@@ -1742,6 +1917,21 @@ def _main_cpu_rig(mesh):
             gen_len=32, cfg=cfg, ctx=_RIG_CTX, k_hi=6, pairs=3))
     except Exception as e:
         result["serve_error"] = str(e)[:200]
+    try:
+        # spec + prefix arms (ISSUE 14): the same rig shard and
+        # matched per-request geometry as the serving arms above, so
+        # the spec-vs-plain ratio reads drafting, not page depth
+        result.update(bench_serve_spec(
+            mesh, n_requests=8, prompt_len=48, gen_len=32,
+            qps_levels=(4.0, 32.0), spec_k=4, cfg=cfg, ctx=_RIG_CTX))
+    except Exception as e:
+        result["serve_spec_error"] = str(e)[:200]
+    try:
+        result.update(bench_prefix_ttft(
+            mesh, prompt_len=96, gen_len=4, pairs=5, cfg=cfg,
+            ctx=_RIG_CTX))
+    except Exception as e:
+        result["prefix_ttft_error"] = str(e)[:200]
     try:
         # iterations are sub-ms at this shape, so the chains can be
         # long: short ks flipped the slope sign run-to-run under the

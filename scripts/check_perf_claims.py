@@ -73,6 +73,9 @@ REQUIRED_CLAIMS = (
      "triton_dist_tpu/kernels/allreduce.py"),
     ("allreduce_wire_fp8_vs_native", "docs/performance.md"),
     ("ag_gemm_wire_fp8_vs_native", "docs/performance.md"),
+    # spec decoding + radix prefix cache (ISSUE 14)
+    ("spec_vs_plain_tokens", "docs/serving.md"),
+    ("prefix_hit_ttft", "docs/serving.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still
@@ -81,15 +84,17 @@ REQUIRED_CLAIMS = (
 # that round, and the rule closes BY ITSELF the moment a
 # round-N-or-later artifact exists — measured: the claim is checked;
 # absent: the required claim is unbacked and FAILS (no manual
-# bookkeeping left to forget). EMPTY since round 6 (ISSUE 12):
+# bookkeeping left to forget). Emptied in round 6 (ISSUE 12):
 # BENCH_r06.json — the first serving-era artifact, produced on the
-# documented cpu-world1 rig (docs/performance.md "Rigs") — carries all
-# five formerly-graced keys (serve_vs_seq_tokens, the sp_prefill
-# family, the quantized-wire pair), so every required claim is now
-# CHECKED against a measurement. The mechanism stays for future keys:
-# a new metric family ships with its round number here and its claim
-# in REQUIRED_CLAIMS, and the next artifact converts it.
-PENDING_FIRST_ARTIFACT = {}
+# documented cpu-world1 rig (docs/performance.md "Rigs") — carried all
+# five formerly-graced keys. ISSUE 14 re-arms the mechanism for the
+# spec/prefix families: BENCH_r07.json (same rig) already measures
+# both, so the grace below is normally inert — it only bites if a
+# later round drops the arms, and it dies by itself at round 14.
+PENDING_FIRST_ARTIFACT = {
+    "spec_vs_plain_tokens": 14,
+    "prefix_hit_ttft": 14,
+}
 
 
 def _artifact_round(label) -> int:

@@ -341,9 +341,22 @@ def test_decomposition_histograms_stream_at_retirement(eng1, prompts):
 
 def test_ledger_build_is_pure(eng1, prompts):
     """build_ledger must not mutate scheduler or request state: two
-    builds produce identical documents."""
-    sch = Scheduler(eng1, **GEO)
+    builds produce identical documents — including the ISSUE 14
+    columns (spec_verify/prefix), exercised here on a spec+prefix
+    scheduler so the extension rides the purity pin."""
+    from triton_dist_tpu.spec import NgramDraft, SpecConfig
+
+    sch = Scheduler(eng1, spec=SpecConfig(k=3, draft=NgramDraft()),
+                    prefix_cache=True, prefix_block=8, **GEO)
     _run(sch, prompts, gen=3)
     a = build_ledger(sch)
     b = build_ledger(sch)
     assert a == b
+    # the new columns are present on every row, the close contract is
+    # untouched (spec_verify is a SUB-bucket of decode, never added to
+    # the close sum — tol unchanged)
+    for row in a["requests"]:
+        assert {"spec_verify_us", "spec_steps",
+                "prefix_hit_tokens"} <= set(row)
+        assert row["spec_verify_us"] <= row["decode_us"] * 1.001 + 1
+    assert check_close(a) == []

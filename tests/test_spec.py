@@ -1,0 +1,355 @@
+"""Speculative decoding on the serve plane (ISSUE 14).
+
+The load-bearing property: with spec ON, every request's token stream
+is BITWISE equal to the spec-OFF (and sequential) run — greedy and
+sampled, host loop and resident — because the per-position verify step
+samples each column under the per-(seed, token-index) key the
+sequential path would use, so the longest-accepted-prefix rule only
+ever emits the model's own tokens. Around it: the n-gram draft units,
+the accept rule, the k chooser/pruner, the FailStep-during-verify
+chaos cell (no double emission), metrics, and the bench schema.
+
+Wall budget: ONE engine geometry per module (module-scoped fixtures);
+the spec scheduler adds exactly one per_pos executable and the
+resident-spec loop one spec_k executable.
+"""
+
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.runtime import make_mesh
+from triton_dist_tpu.serve import Scheduler
+from triton_dist_tpu.spec import NgramDraft, SpecConfig, accept_tokens
+from triton_dist_tpu.spec.verify import draft_cap
+
+GEO = dict(slots=3, chunk=6, page=8)
+K = 4  # one spec width (= one per_pos/spec_k executable) per module
+GEN = 16
+
+
+def _spec():
+    return SpecConfig(k=K, draft=NgramDraft())
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(mesh_shape=(1,), axis_names=("tp",))
+
+
+@pytest.fixture(scope="module")
+def eng1(mesh1):
+    cfg = ModelConfig.tiny(num_q_heads=4, num_kv_heads=2,
+                           max_positions=128)
+    return Engine(cfg, mesh1, decode_mode="ar", max_len=128,
+                  donate_cache=False)
+
+
+@pytest.fixture(scope="module")
+def prompts(eng1):
+    rng = np.random.default_rng(3)
+    v = eng1.cfg.vocab_size
+    return [list(map(int, rng.integers(0, v, 10))) for _ in range(3)]
+
+
+@pytest.fixture(scope="module")
+def baseline(eng1, prompts):
+    """Spec-off greedy reference + its step count (greedy decode of a
+    random-weight model self-loops, so drafts really get accepted)."""
+    sch = Scheduler(eng1, **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    sch.run()
+    return [r.out_tokens for r in reqs], sch.worker.n_steps
+
+
+# ---------- draft units ----------
+
+
+def test_ngram_draft_finds_cycle():
+    d = NgramDraft(n=3)
+    hist = [1, 2, 3, 4, 2, 3]
+    # trailing [2, 3] occurred at i=1; proposes what followed: [4, 2]
+    assert d.propose(hist, 2) == [4, 2]
+    assert d.propose(hist, 5) == [4, 2, 3]
+    # deterministic (the retry contract)
+    assert d.propose(hist, 2) == d.propose(hist, 2)
+
+
+def test_ngram_draft_prefers_longest_then_most_recent():
+    d = NgramDraft(n=3)
+    # [7, 8] occurs twice earlier; the MOST RECENT one (i=3) wins
+    hist = [7, 8, 1, 7, 8, 2, 7, 8]
+    assert d.propose(hist, 1) == [2]
+    # a full trailing 3-gram match beats the 2-gram
+    hist2 = [5, 7, 8, 9, 1, 5, 7, 8]
+    assert d.propose(hist2, 1) == [9]
+
+
+def test_ngram_draft_empty_cases():
+    d = NgramDraft(n=3)
+    assert d.propose([], 4) == []
+    assert d.propose([1], 4) == []
+    assert d.propose([1, 2, 3], 0) == []
+    assert d.propose([1, 2, 3], 4) == []  # no repeat anywhere
+
+
+def test_draft_cap_bounds():
+    # k, chunk-1, remaining-1 and the pool horizon all cap the width
+    assert draft_cap(4, 6, 20, 0, 10, 128) == 4
+    assert draft_cap(8, 6, 20, 0, 10, 128) == 5   # chunk - 1
+    assert draft_cap(4, 6, 20, 8, 10, 128) == 1   # max_new - n_out - 1
+    assert draft_cap(4, 6, 20, 9, 10, 128) == 0   # last token: no spec
+    assert draft_cap(4, 6, 126, 0, 10, 128) == 2  # t_max - history
+    assert draft_cap(0, 6, 20, 0, 10, 128) == 0   # k=0 = off
+
+
+# ---------- the accept rule ----------
+
+
+def test_accept_tokens_longest_prefix():
+    # o = [5, 6, 7], d = [5, 6, 9]: accept 2, emit o_0..o_2
+    assert accept_tokens([5, 6, 9], [5, 6, 7]) == [5, 6, 7]
+    assert accept_tokens([9, 6, 9], [5, 6, 7]) == [5]  # reject at 0
+    assert accept_tokens([5, 6, 7], [5, 6, 7, 8]) == [5, 6, 7, 8]
+    assert accept_tokens([], [5]) == [5]  # kd=0: the plain step
+
+
+def test_accept_tokens_eos_and_budget_cuts():
+    assert accept_tokens([5, 6], [5, 6, 7], eos_id=6) == [5, 6]
+    assert accept_tokens([5, 6], [5, 6, 7], max_emit=2) == [5, 6]
+    assert accept_tokens([5, 6], [5, 6, 7], eos_id=9) == [5, 6, 7]
+
+
+# ---------- bit-identity (the acceptance oracle) ----------
+
+
+def test_spec_bitwise_greedy_and_saves_steps(eng1, prompts, baseline):
+    base, base_steps = baseline
+    sch = Scheduler(eng1, spec=_spec(), **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    sch.run()
+    assert [r.out_tokens for r in reqs] == base
+    m = sch.metrics()
+    assert m["spec_proposed"] > 0 and m["spec_accepted"] > 0, (
+        "greedy self-loops must drive acceptance on this traffic")
+    assert sch.worker.n_steps < base_steps, (
+        "accepted drafts must save device steps")
+    assert 0 < m["spec_accept_rate"] <= 1
+    assert sch.obs.hist_count("spec_accept_rate") > 0
+    sch.pool.check()
+
+
+def test_spec_bitwise_sampled(eng1, prompts):
+    def run(spec):
+        sch = Scheduler(eng1, spec=spec, **GEO)
+        reqs = [sch.submit(p, max_new_tokens=GEN, temperature=0.9,
+                           seed=41 + i) for i, p in enumerate(prompts)]
+        sch.run()
+        return [r.out_tokens for r in reqs]
+
+    assert run(_spec()) == run(None)
+
+
+def test_spec_bitwise_resident(eng1, prompts, baseline):
+    base, _ = baseline
+    sch = Scheduler(eng1, resident=True, window=4, spec=_spec(), **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    sch.run()
+    assert [r.out_tokens for r in reqs] == base
+    m = sch.metrics()
+    assert m["spec_proposed"] > 0 and m["spec_accepted"] > 0
+    sch.pool.check()
+
+
+@pytest.mark.slow  # duplicates the host sampled + resident greedy
+# pins above (the key stream and the KIND_VERIFY path are each already
+# covered); kept for the full matrix on deep runs
+def test_spec_bitwise_resident_sampled(eng1, prompts):
+    def run(spec):
+        sch = Scheduler(eng1, resident=True, window=4, spec=spec,
+                        **GEO)
+        reqs = [sch.submit(p, max_new_tokens=GEN, temperature=0.9,
+                           seed=71 + i) for i, p in enumerate(prompts)]
+        sch.run()
+        return [r.out_tokens for r in reqs]
+
+    assert run(_spec()) == run(None)
+
+
+def test_spec_eos_mid_verify(eng1, prompts, baseline):
+    """An eos landing INSIDE an accepted prefix truncates exactly
+    where sequential decode would stop (host + resident)."""
+    base, _ = baseline
+    eos = base[0][8]
+    idx = base[0].index(eos)
+    for kw in ({}, {"resident": True, "window": 4}):
+        sch = Scheduler(eng1, spec=_spec(), **GEO, **kw)
+        req = sch.submit(prompts[0], max_new_tokens=GEN, eos_id=eos)
+        sch.run()
+        assert req.out_tokens == base[0][:idx + 1], kw
+        assert req.finish_reason == "eos"
+        sch.pool.check()
+
+
+def test_spec_with_eviction_bitwise(eng1, prompts, baseline):
+    """Spec + page pressure: verify rows grow pages like decode rows;
+    eviction/requeue under spec stays bitwise."""
+    base, _ = baseline
+    sch = Scheduler(eng1, spec=_spec(), total_pages=7, **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    sch.run()
+    assert sum(r.n_evictions for r in reqs) > 0, (
+        "pool was not constrained enough to exercise eviction")
+    assert [r.out_tokens for r in reqs] == base
+    sch.pool.check()
+
+
+# ---------- chaos: FailStep during a verify step ----------
+
+
+def test_failstep_during_verify_no_double_emission(eng1, prompts,
+                                                   baseline):
+    """The chaos-cell property as a unit: a transient FailStep landing
+    on a spec-verify step retries WITHOUT double-emitting accepted
+    tokens (the deterministic draft rebuilds the identical row; the
+    emission happens once, after the successful attempt)."""
+    from triton_dist_tpu import faults
+
+    base, _ = baseline
+    sch = Scheduler(eng1, spec=_spec(), max_step_retries=2,
+                    retry_backoff_s=0.0005, **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    # at_step 4: decode territory on this traffic (prompts are 10
+    # tokens = 2 chunks; slot count 3 → step 4 is decode/verify)
+    plan = faults.FaultPlan(faults.FailStep(at_step=4, times=1))
+    with faults.injecting(plan):
+        sch.run()
+    m = sch.metrics()
+    assert m["step_retries"] == 1 and m["quarantined"] == 0
+    assert [r.out_tokens for r in reqs] == base
+    sch.pool.check()
+
+
+def test_chaos_serve_spec_cells(eng1):
+    """The matrix cells land green: the clean column (which also runs
+    the shared-page eviction polarity pair) and one transient class."""
+    from triton_dist_tpu.faults import chaos
+
+    cells = chaos.run_matrix(None, protocols=("serve_spec",),
+                             faults=("none", "delayed_send"),
+                             serve_engine=eng1)
+    probs = chaos.check_matrix(cells)
+    assert not probs, probs
+    assert {c.fault: c.outcome for c in cells} == {
+        "none": "recovered", "delayed_send": "recovered"}
+
+
+# ---------- chooser / pruner ----------
+
+
+def test_choose_spec_k_monotone_in_acceptance():
+    from triton_dist_tpu.perf_model import (
+        CHIPS,
+        choose_spec_k,
+        estimate_spec_step_ms,
+        expected_spec_tokens,
+    )
+
+    chip = CHIPS["TPU v5 lite"]
+    dims = dict(num_layers=36, hidden=4096, inter_loc=1536, hq_loc=4,
+                hkv_loc=1, head_dim=128, vocab_loc=18992, chip=chip)
+    ks = [choose_spec_k(accept_rate=p, **dims)
+          for p in (0.0, 0.3, 0.6, 0.9)]
+    assert ks == sorted(ks)
+    assert ks[0] == 0 and ks[-1] >= 2  # off at 0, wide at high rates
+    # k=0 is exactly the plain step per token
+    t0 = estimate_spec_step_ms(k=0, accept_rate=0.5, **dims)
+    t4 = estimate_spec_step_ms(k=4, accept_rate=0.9, **dims)
+    assert t4 < t0
+    assert expected_spec_tokens(0.0, 4) == 1.0
+    assert expected_spec_tokens(1.0, 4) == 5.0
+
+
+def test_prune_spec_ks_keeps_off_switch():
+    from triton_dist_tpu.autotuner import prune_spec_ks, spec_k_space
+    from triton_dist_tpu.perf_model import CHIPS
+
+    chip = CHIPS["TPU v5 lite"]
+    dims = dict(num_layers=36, hidden=4096, inter_loc=1536, hq_loc=4,
+                hkv_loc=1, head_dim=128, vocab_loc=18992, chip=chip)
+    assert 0 in spec_k_space()
+    live = prune_spec_ks(accept_rate=0.0, top_n=2, **dims)
+    assert 0 in live and len(live) <= 2
+    hi = prune_spec_ks(accept_rate=0.9, top_n=3, **dims)
+    assert 0 in hi and hi[0] > 0  # best-ranked first at high rates
+
+
+# ---------- wiring / guards ----------
+
+
+def test_spec_needs_room_in_chunk(eng1):
+    with pytest.raises(AssertionError, match="k\\+1 <= chunk"):
+        Scheduler(eng1, spec=SpecConfig(k=8, draft=NgramDraft()),
+                  slots=3, chunk=6, page=8)
+
+
+def test_worker_per_pos_step_polarity(eng1):
+    sch = Scheduler(eng1, spec=_spec(), **GEO)
+    with pytest.raises(AssertionError, match="step_spec"):
+        sch.worker.step(np.zeros((3, 6), np.int32),
+                        np.zeros((3,), np.int32),
+                        np.zeros((3,), np.float32),
+                        np.zeros((3, 2), np.uint32))
+
+
+def test_trend_directions_for_new_families():
+    from triton_dist_tpu.obs.trend import higher_is_better
+
+    assert higher_is_better("serve_spec_tokens_per_s")
+    assert higher_is_better("spec_vs_plain_tokens")
+    assert higher_is_better("spec_accept_rate")
+    assert not higher_is_better("prefix_hit_ttft")
+    assert not higher_is_better("prefix_hit_ttft_us")
+
+
+def test_trend_picks_up_spec_families_from_artifacts():
+    """The satellite pin: obs/trend reads the new families through the
+    EXISTING artifact reader — no special-casing — so the committed
+    r07 artifact must surface them in the series."""
+    from triton_dist_tpu.obs import trend
+
+    series = trend.bench_series()
+    keys = {k for (k, _rig) in series}
+    assert {"spec_vs_plain_tokens", "spec_accept_rate",
+            "prefix_hit_ttft", "serve_spec_tokens_per_s"} <= keys, (
+        sorted(keys))
+
+
+# ---------- bench schema ----------
+
+
+def test_bench_spec_schema_travels_together():
+    import bench
+
+    lvl = {"spec": {"tokens_per_s": 20.0},
+           "plain": {"tokens_per_s": 18.0}}
+    good = {
+        "metric": "x", "value": 1.0, "unit": "r", "vs_baseline": 1.0,
+        "serve_spec_tokens_per_s": 20.0,
+        "serve_spec_plain_tokens_per_s": 18.0,
+        "spec_vs_plain_tokens": 1.11, "spec_accept_rate": 0.4,
+        "serve_spec_levels": {"qps4": dict(lvl), "qps32": dict(lvl)},
+    }
+    assert bench.check_result(good) == []
+    bad = dict(good)
+    del bad["spec_accept_rate"]
+    assert any("travel together" in p for p in bench.check_result(bad))
+    bad = dict(good)
+    bad["serve_spec_levels"] = {"qps4": dict(lvl)}
+    assert any(">= 2 QPS levels" in p for p in bench.check_result(bad))
+    bad = dict(good)
+    bad["spec_accept_rate"] = 1.5
+    assert any("outside [0, 1]" in p for p in bench.check_result(bad))
+    bad = dict(good)
+    del bad["serve_spec_levels"]["qps4"]["plain"]
+    assert any("tokens_per_s" in p for p in bench.check_result(bad))

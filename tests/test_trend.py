@@ -92,11 +92,12 @@ def test_stable_series_and_neutral_keys_are_clean(tmp_path):
     _write_round(tmp_path, 2, {"foo_ms": 10.4, "ep_moe_chunks": 4})
     rep = trend.analyze(repo=str(tmp_path))
     assert rep["flags"] == []
-    # the only note a clean corpus may carry is the stale_ack
-    # bookkeeping: the repo-level ACKNOWLEDGED entry matches no flag
-    # HERE, and the sentinel says so rather than silently accreting
-    # mutes
-    assert [n["kind"] for n in rep["notes"]] == ["stale_ack"]
+    # the only notes a clean corpus may carry are the stale_ack
+    # bookkeeping rows: every repo-level ACKNOWLEDGED entry matches no
+    # flag HERE, and the sentinel says so rather than silently
+    # accreting mutes (one row per ledger entry)
+    assert ([n["kind"] for n in rep["notes"]]
+            == ["stale_ack"] * len(trend.ACKNOWLEDGED))
 
 
 def test_acknowledgement_is_kind_scoped(tmp_path):

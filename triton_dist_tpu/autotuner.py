@@ -505,6 +505,50 @@ def prune_wire_formats(nbytes, n, dtype=None, collective="allreduce",
     return live
 
 
+def spec_k_space():
+    """Candidate draft widths for a measured spec-decode sweep
+    (bench.py's serve-spec arm, a caller's autotune over the
+    SpecConfig(k=) knob): 0 (off) through the widths the verify row
+    can carry without dominating the step."""
+    return [0, 1, 2, 4, 6, 8]
+
+
+def prune_spec_ks(num_layers, hidden, inter_loc, hq_loc, hkv_loc,
+                  head_dim, vocab_loc, accept_rate, configs=None,
+                  slots=4, kv_tokens=0, dtype=None, chip=None,
+                  attn_impl="flash", top_n=None):
+    """Model-pruned draft-width candidates at one shape + acceptance
+    rate: rank by perf_model.estimate_spec_step_ms (per-EMITTED-token
+    cost), dedupe, optionally cap at top_n. k=0 always survives (the
+    off switch a tuned pick degrades to — the prune_wire_formats
+    native-survives discipline), so the result is never empty."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.perf_model import estimate_spec_step_ms
+
+    dtype = dtype or jnp.bfloat16
+    ks = sorted({int(k) for k in
+                 (configs if configs is not None else spec_k_space())
+                 if int(k) >= 0})
+    if 0 not in ks:
+        ks.insert(0, 0)
+
+    def model_ms(k):
+        return estimate_spec_step_ms(
+            num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+            vocab_loc, k=k, accept_rate=accept_rate, slots=slots,
+            kv_tokens=kv_tokens, dtype=dtype, chip=chip,
+            attn_impl=attn_impl)
+
+    live = sorted(ks, key=model_ms)
+    if top_n is not None and len(live) > top_n:
+        keep = live[:top_n]
+        if 0 not in keep:
+            keep[-1] = 0
+        live = keep
+    return live
+
+
 def ep_moe_config_space():
     """Candidate EpMoeConfig grid for the chunk-pipelined EP MoE
     (kernels/ep_a2a.ep_moe_pipeline): chunk counts spanning no-pipelining

@@ -55,7 +55,7 @@ from triton_dist_tpu.faults.plan import (
 
 PROTOCOLS = ("two_shot_all_reduce", "all_to_all_chunked",
              "low_latency_allgather", "flash_prefill", "serve_step",
-             "serve_resident")
+             "serve_resident", "serve_spec")
 FAULTS = ("none", "delayed_send", "stalled_rank", "dropped_signal",
           "bitflip_payload", "bitflip_scale")
 OK_OUTCOMES = ("detected", "recovered", "n/a")
@@ -321,6 +321,139 @@ def _run_serve_step(mesh, fault: str, engine=None) -> CellResult:
         f"retries={m['step_retries']}")
 
 
+def _run_serve_spec(mesh, fault: str, engine=None) -> CellResult:
+    """The spec/prefix cell (ISSUE 14): a FailStep lands DURING a
+    spec-verify step — the retry ladder (or quarantine) must absorb it
+    WITHOUT double-emitting accepted tokens (the draft proposer is
+    deterministic in the unchanged history, so a retried verify step
+    rebuilds the identical row; emissions only happen once, after the
+    successful attempt). Every token that did stream is re-checked
+    against the fault-free plain-decode reference — bitwise. The
+    clean column additionally pins the pool-pressure polarity pair:
+    reclaim must pick an UNSHARED victim under pressure, and forcing
+    the eviction of a refcount>1 shared block must be REFUSED
+    (assert)."""
+    from triton_dist_tpu.serve import Scheduler
+    from triton_dist_tpu.spec import SpecConfig
+
+    if engine is None:
+        return CellResult("serve_spec", fault, "n/a",
+                          "no engine provided")
+
+    class _CycleDraft:
+        # always proposes (repeat the last token): EVERY decode step
+        # is a verify step, so the injected fault provably lands on
+        # one. Deterministic in the history, like the contract demands.
+        def propose(self, history, k):
+            return [int(history[-1])] * k
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, engine.cfg.vocab_size, 9).tolist()
+               for _ in range(2)]
+    geo = dict(slots=2, chunk=6, page=8)
+    spec = SpecConfig(k=3, draft=_CycleDraft())
+
+    ref = Scheduler(engine, **geo)
+    ref_reqs = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    ref.run()
+
+    persistent = fault in ("dropped_signal", "stalled_rank")
+    if fault == "none":
+        plan = None
+    else:
+        err = "integrity" if fault.startswith("bitflip") else "deadline"
+        times = 4 if persistent else 1
+        # at_step 3+: past both prefills — the failing step is a
+        # decode/verify step
+        plan = FaultPlan(FailStep(at_step=3, times=times, error=err))
+
+    sch = Scheduler(engine, spec=spec, max_step_retries=2,
+                    retry_backoff_s=0.0005, **geo)
+    reqs = [sch.submit(p, max_new_tokens=8) for p in prompts]
+    with (contextlib.nullcontext() if plan is None
+          else _fplan.injecting(plan)):
+        sch.run()
+    m = sch.metrics()
+    # the double-emission check IS the bitwise prefix check: a replayed
+    # verify step would duplicate accepted tokens in the stream
+    for r, rr in zip(reqs, ref_reqs):
+        if r.out_tokens != rr.out_tokens[:len(r.out_tokens)]:
+            return CellResult("serve_spec", fault, "silent-wrong",
+                              f"req{r.request_id} tokens diverged "
+                              "(double emission?)")
+    if not all(r.done for r in reqs):
+        return CellResult("serve_spec", fault, "silent-wrong",
+                          "scheduler drained with live requests")
+    if plan is None:
+        ok = (m["quarantined"] == 0 and m["step_retries"] == 0
+              and m["spec_proposed"] > 0
+              and all(r.out_tokens == rr.out_tokens
+                      for r, rr in zip(reqs, ref_reqs)))
+        if ok:
+            ok = _shared_page_polarity(engine)
+        return CellResult(
+            "serve_spec", fault, "recovered" if ok else "silent-wrong",
+            f"clean run (proposed={m['spec_proposed']}, shared-page "
+            "polarity checked)")
+    if persistent:
+        ok = m["quarantined"] == 1 and m["step_retries"] >= 3
+        return CellResult(
+            "serve_spec", fault,
+            "detected" if ok else "silent-wrong",
+            f"quarantined={m['quarantined']} "
+            f"retries={m['step_retries']}")
+    ok = m["quarantined"] == 0 and m["step_retries"] >= 1
+    return CellResult(
+        "serve_spec", fault, "recovered" if ok else "silent-wrong",
+        f"retries={m['step_retries']}")
+
+
+def _shared_page_polarity(engine) -> bool:
+    """Both polarities of the refcount>1 eviction rule on a
+    pressure-sized pool: (a) reclaim under pool pressure frees ONLY
+    unshared cached blocks (live readers keep their pages, allocator
+    invariants hold); (b) force-dropping a node whose pages a live
+    slot still reads raises AssertionError (the refusal)."""
+    from triton_dist_tpu.serve import Scheduler
+
+    rng = np.random.default_rng(14)
+    v = engine.cfg.vocab_size
+    shared_prompt = rng.integers(0, v, 9).tolist()
+    other = rng.integers(0, v, 9).tolist()
+    sch = Scheduler(engine, slots=2, chunk=6, page=8, total_pages=6,
+                    prefix_cache=True, prefix_block=8)
+    # donor populates the cache, then finishes (cache = only holder)
+    a = sch.submit(shared_prompt, max_new_tokens=2)
+    b = sch.submit(other, max_new_tokens=2)
+    sch.run()
+    if sch.prefix.n_blocks() < 2:
+        return False
+    # reader shares the donor's block; its node is now ref>1
+    c = sch.submit(shared_prompt, max_new_tokens=2)
+    sch.step()
+    if c.prefix_len == 0:
+        return False
+    shared_node = next(
+        nd for nd in sch.prefix._iter_leaves()
+        if not sch.prefix._droppable(nd))
+    # polarity (b): forced eviction of the shared block is REFUSED
+    try:
+        sch.prefix._drop(shared_node)
+        return False  # the refusal did not fire
+    except AssertionError:
+        pass
+    # polarity (a): pressure reclaim picks an unshared victim and the
+    # shared node survives
+    before = sch.prefix.n_blocks()
+    freed = sch.prefix.reclaim(6)
+    ok = (freed > 0 and sch.prefix.n_blocks() < before
+          and not sch.prefix._droppable(shared_node))
+    sch.run()
+    sch.pool.check()
+    sch.prefix.check()
+    return ok and all(r.done for r in (a, b, c))
+
+
 def _run_serve_resident(mesh, fault: str, engine=None) -> CellResult:
     """The megakernel-resident serving cell (ISSUE 12). Fault mapping:
     transient classes (delayed_send / bitflips) land as a one-window
@@ -421,6 +554,8 @@ def run_matrix(mesh, axis: str = "tp", protocols=None, faults=None,
         "serve_step": lambda f: _run_serve_step(mesh, f,
                                                 engine=serve_engine),
         "serve_resident": lambda f: _run_serve_resident(
+            mesh, f, engine=serve_engine),
+        "serve_spec": lambda f: _run_serve_spec(
             mesh, f, engine=serve_engine),
     }
     out: List[CellResult] = []

@@ -45,8 +45,10 @@ block candidates by `autotuner.prune_flash_prefill_configs`; see
 `sp_prefill_attention` (the autotuner-selectable switch) and
 docs/performance.md "Prefill regimes". Claimed against the bench artifact
 (first measured by the r06 cpu-world1 rig — interpreter semantics, see
-docs/performance.md "Rigs"; the default-rig S=4096 artifact re-narrows)
-as [perf:sp_prefill_vs_ring=0.3-1.4] / [perf:sp_prefill_vs_xla=0.45-2.0].
+docs/performance.md "Rigs"; the bands span the 0.67-2.4x run-to-run
+spread of the 2-core rig's slope ratio, and the default-rig S=4096
+artifact re-narrows)
+as [perf:sp_prefill_vs_ring=0.3-2.6] / [perf:sp_prefill_vs_xla=0.45-2.0].
 """
 
 from __future__ import annotations

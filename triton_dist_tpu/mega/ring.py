@@ -62,11 +62,22 @@ IR_TEMP_BITS = 6   # admission: f32 temperature bit pattern
 IR_SEED = 7        # admission: sampling seed (per-request key stream)
 IR_EOS = 8         # admission: eos_id + 1; 0 = no eos stop
 IR_REQID = 9       # request id (echoed in output records)
+IR_NOUT = 10       # verify: the n_out the drafts were proposed at
+IR_SPEC_K = 11     # verify: number of staged draft tokens
+IR_PREFIX = 12     # admission: prefix-cache hit length (tokens whose
+#                    KV is already live in the record's shared pages —
+#                    the device starts prefill AND the slot length
+#                    there; serve/prefix.py)
 IR_HEADER = 16     # header rows reserved (room to grow the contract)
 
 KIND_NOOP = 0      # consumed, no effect (host-side hole punching)
 KIND_ADMIT = 1
 KIND_RETIRE = 2
+KIND_VERIFY = 3    # spec-verify slot (ISSUE 14): k draft tokens staged
+#                    in the prompt region; the device verifies them in
+#                    the next step iff the slot's req/n_out still match
+#                    (a stale record — the slot decoded past the
+#                    proposal or turned over — is a consumed no-op)
 
 # -- device→host output record (i32 fields) ----------------------------------
 
@@ -77,10 +88,14 @@ OR_TOKEN = 3       # emitted token (-1 on a token-less retirement)
 OR_FLAGS = 4       # FLAG_* bits
 OR_REASON = 5      # REASON_* on retirement rows
 OR_REQID = 6
+OR_SPEC_K = 7      # spec-verify steps: drafts verified (on the step's
+#                    FIRST record only; 0 elsewhere) — the host's
+#                    acceptance-rate source
 OR_WIDTH = 8
 
 FLAG_EMIT = 1      # the record carries a sampled token
 FLAG_RETIRED = 2   # the slot retired at this record
+FLAG_SPEC = 4      # the token came out of a spec-verify step
 
 REASON_EOS = 1
 REASON_LENGTH = 2
@@ -100,6 +115,10 @@ SS_EOS = 8         # eos_id + 1; 0 = none
 SS_LAST_TOK = 9    # decode input (the previous emission)
 SS_REC = 10        # ring row of the admission record (prompt source)
 SS_REQID = 11
+SS_SPEC_REC = 12   # ring row of a pending verify record (draft source)
+SS_SPEC_SEQ = 13   # that record's seq (self-validation against reuse)
+SS_SPEC_K = 14     # staged draft count; 0 = no verify pending
+#                    (one-shot: cleared after the step that used it)
 SS_WIDTH = 16
 
 
@@ -121,6 +140,7 @@ class OutRecord(NamedTuple):
     flags: int
     reason: int
     req_id: int
+    spec_k: int = 0
 
     @property
     def emitted(self) -> bool:
@@ -129,6 +149,10 @@ class OutRecord(NamedTuple):
     @property
     def retired(self) -> bool:
         return bool(self.flags & FLAG_RETIRED)
+
+    @property
+    def spec(self) -> bool:
+        return bool(self.flags & FLAG_SPEC)
 
 
 def decode_out_ring(buf, count: int) -> List[OutRecord]:
@@ -147,7 +171,8 @@ def decode_out_ring(buf, count: int) -> List[OutRecord]:
         out.append(OutRecord(
             seq=int(r[OR_SEQ]), slot=int(r[OR_SLOT]), step=int(r[OR_STEP]),
             token=int(r[OR_TOKEN]), flags=int(r[OR_FLAGS]),
-            reason=int(r[OR_REASON]), req_id=int(r[OR_REQID])))
+            reason=int(r[OR_REASON]), req_id=int(r[OR_REQID]),
+            spec_k=int(r[OR_SPEC_K])))
     return out
 
 
@@ -253,10 +278,13 @@ class InjectionRing:
 
     def admit(self, slot: int, prompt, max_new: int, temperature: float,
               seed: int, eos_id: Optional[int], req_id: int,
-              table_row, at_step: int = 0) -> None:
+              table_row, at_step: int = 0, prefix: int = 0) -> None:
         prompt = np.asarray(prompt, np.int32)
         assert prompt.ndim == 1 and 1 <= prompt.size <= self.prompt_cap, (
             f"prompt of {prompt.size} tokens vs cap {self.prompt_cap}")
+        assert 0 <= prefix < prompt.size, (
+            f"prefix {prefix} must leave >= 1 token of {prompt.size} "
+            "to prefill")
         table_row = np.asarray(table_row, np.int32)
         assert table_row.shape == (self.max_pages,), (
             f"table row {table_row.shape} != ({self.max_pages},)")
@@ -272,11 +300,40 @@ class InjectionRing:
         r[IR_SEED] = seed
         r[IR_EOS] = 0 if eos_id is None else eos_id + 1
         r[IR_REQID] = req_id
+        r[IR_PREFIX] = prefix
         r[IR_HEADER:IR_HEADER + self.max_pages] = table_row
         r[IR_HEADER + self.max_pages:
           IR_HEADER + self.max_pages + prompt.size] = prompt
         self._commit(row)
         self._pins[req_id] = self.published  # this record's seq
+
+    def verify(self, slot: int, req_id: int, n_out: int, drafts,
+               at_step: int = 0) -> object:
+        """Stage a spec-verify record (KIND_VERIFY, ISSUE 14): the
+        draft tokens ride in the prompt region; the device verifies
+        them in its next step iff the slot still serves `req_id` at
+        exactly `n_out` emitted tokens (staleness self-check — the
+        device may have decoded past the proposal). Returns the pin
+        key: verify rows are read by the step AFTER consumption, so
+        the producer pins them like admission rows; the worker unpins
+        once the window the record rode has returned."""
+        drafts = np.asarray(drafts, np.int32)
+        assert drafts.ndim == 1 and 1 <= drafts.size <= self.prompt_cap
+        row = self._claim_row()
+        r = self.buf[row]
+        r[:] = 0
+        r[IR_KIND] = KIND_VERIFY
+        r[IR_SLOT] = slot
+        r[IR_AT_STEP] = at_step
+        r[IR_REQID] = req_id
+        r[IR_NOUT] = n_out
+        r[IR_SPEC_K] = drafts.size
+        r[IR_HEADER + self.max_pages:
+          IR_HEADER + self.max_pages + drafts.size] = drafts
+        self._commit(row)
+        pin = ("spec", self.published)
+        self._pins[pin] = self.published
+        return pin
 
     def retire(self, slot: int, req_id: int, at_step: int = 0) -> None:
         row = self._claim_row()
@@ -334,10 +391,16 @@ def device_consume(ring, published, consumed, step, slot_state, table,
     Returns (consumed, slot_state, table, lengths, retired_now) where
     retired_now (K,) i32 flags slots a RETIRE record deactivated at
     THIS boundary (the caller reports them out). ADMIT loads the slot
-    row, installs the record's page-table row, and zeroes the slot
-    length; RETIRE deactivates iff the record's req_id matches the
-    slot's (a stale retirement for an already-self-retired request is
-    a no-op). Bounded: consumes at most `published - consumed` rows.
+    row, installs the record's page-table row, and starts the slot
+    length (and prefill cursor) at the record's IR_PREFIX — 0 on a
+    cold admission; a prefix-cache hit starts both at the cached
+    coverage, whose KV is already live in the shared pages the table
+    row carries (serve/prefix.py). RETIRE deactivates iff the record's
+    req_id matches the slot's (a stale retirement for an already-
+    self-retired request is a no-op). VERIFY stages the record's
+    drafts on the slot (SS_SPEC_*) iff the slot still serves that
+    req_id at that n_out in decode — else a consumed no-op. Bounded:
+    consumes at most `published - consumed` rows.
     """
     cap = ring.shape[0]
     max_pages = table.shape[1]
@@ -356,9 +419,15 @@ def device_consume(ring, published, consumed, step, slot_state, table,
         is_retire = ((rec[IR_KIND] == KIND_RETIRE)
                      & (ss[slot, SS_ACTIVE] > 0)
                      & (ss[slot, SS_REQID] == rec[IR_REQID]))
+        is_verify = ((rec[IR_KIND] == KIND_VERIFY)
+                     & (ss[slot, SS_ACTIVE] > 0)
+                     & (ss[slot, SS_PHASE] == 1)
+                     & (ss[slot, SS_REQID] == rec[IR_REQID])
+                     & (ss[slot, SS_N_OUT] == rec[IR_NOUT]))
         admit_row = (
             jnp.zeros((SS_WIDTH,), jnp.int32)
             .at[SS_ACTIVE].set(1)
+            .at[SS_POS].set(rec[IR_PREFIX])
             .at[SS_PROMPT_LEN].set(rec[IR_PROMPT_LEN])
             .at[SS_MAX_NEW].set(rec[IR_MAX_NEW])
             .at[SS_TEMP_BITS].set(rec[IR_TEMP_BITS])
@@ -368,12 +437,19 @@ def device_consume(ring, published, consumed, step, slot_state, table,
             .at[SS_REQID].set(rec[IR_REQID])
         )
         retired_row = ss[slot].at[SS_ACTIVE].set(0)
-        new_row = jnp.where(is_admit, admit_row,
-                            jnp.where(is_retire, retired_row, ss[slot]))
+        verify_row = (ss[slot]
+                      .at[SS_SPEC_REC].set(rec_row)
+                      .at[SS_SPEC_SEQ].set(rec[IR_SEQ])
+                      .at[SS_SPEC_K].set(rec[IR_SPEC_K]))
+        new_row = jnp.where(
+            is_admit, admit_row,
+            jnp.where(is_retire, retired_row,
+                      jnp.where(is_verify, verify_row, ss[slot])))
         ss = ss.at[slot].set(new_row)
         tb = tb.at[slot].set(jnp.where(
             is_admit, rec[IR_HEADER:IR_HEADER + max_pages], tb[slot]))
-        ln = ln.at[slot].set(jnp.where(is_admit, 0, ln[slot]))
+        ln = ln.at[slot].set(jnp.where(is_admit, rec[IR_PREFIX],
+                                       ln[slot]))
         rt = rt.at[slot].set(jnp.where(is_retire, 1, rt[slot]))
         return consumed + 1, ss, tb, ln, rt
 
@@ -425,5 +501,80 @@ def slot_plan(ring, slot_state, chunk: int, max_pages: int):
             jax.random.PRNGKey(ss_row[SS_SEED]), ss_row[SS_N_OUT])
         key = jnp.where(emits, key, jnp.zeros_like(key))
         return tokens, n.astype(jnp.int32), temp, key, emits
+
+    return jax.vmap(one)(slot_state)
+
+
+def slot_plan_spec(ring, slot_state, chunk: int, max_pages: int,
+                   k_max: int):
+    """The spec-capable step plan (ISSUE 14): like `slot_plan`, plus a
+    decoding slot with a FRESH staged verify record (SS_SPEC_*, set by
+    device_consume) becomes a VERIFY row — [last_tok, d_1..d_kd] with
+    n_valid = 1 + kd — and every column carries its own sampling key
+    (fold_in(PRNGKey(seed), n_out + column-offset): the per-(seed,
+    token-index) stream, so column j's token is bitwise the sequential
+    emission for output index n_out + j).
+
+    Freshness is self-validated against the ring row (seq / kind /
+    req_id / n_out all re-checked): a verify row the producer has
+    since overwritten, or one staged for a state the slot has decoded
+    past, degrades to the plain one-token decode row — stale proposals
+    cost nothing and can never corrupt.
+
+    Returns (tokens (K, C) i32, n_valid (K,), temps (K,) f32,
+    keys (K, C, 2) u32, emits (K,) bool, kd (K,) i32)."""
+    prompt_base = IR_HEADER + max_pages
+
+    def one(ss_row):
+        active = ss_row[SS_ACTIVE] > 0
+        prefill = ss_row[SS_PHASE] == 0
+        pos = ss_row[SS_POS]
+        plen = ss_row[SS_PROMPT_LEN]
+        n_pref = jnp.minimum(chunk, plen - pos)
+        rec = ring[ss_row[SS_REC]]
+        prow = jax.lax.dynamic_slice(
+            rec, (prompt_base + pos,), (chunk,))
+        # -- staged verify record, self-validated against the ring row
+        srec = ring[ss_row[SS_SPEC_REC]]
+        fresh = (active & (~prefill) & (ss_row[SS_SPEC_K] > 0)
+                 & (srec[IR_SEQ] == ss_row[SS_SPEC_SEQ])
+                 & (srec[IR_KIND] == KIND_VERIFY)
+                 & (srec[IR_REQID] == ss_row[SS_REQID])
+                 & (srec[IR_NOUT] == ss_row[SS_N_OUT]))
+        kd = jnp.where(
+            fresh,
+            jnp.minimum(
+                jnp.minimum(ss_row[SS_SPEC_K], k_max),
+                jnp.minimum(
+                    chunk - 1,
+                    ss_row[SS_MAX_NEW] - ss_row[SS_N_OUT] - 1)),
+            0)
+        kd = jnp.maximum(kd, 0)
+        drow_spec = jax.lax.dynamic_slice(
+            srec, (prompt_base,), (chunk,))
+        drow = jnp.concatenate(
+            [ss_row[SS_LAST_TOK][None], drow_spec[:chunk - 1]])
+        tokens = jnp.where(prefill, prow, drow)
+        n = jnp.where(prefill, n_pref, 1 + kd)
+        n = jnp.where(active, n, 0)
+        tokens = jnp.where(
+            active & (jnp.arange(chunk) < n), tokens, 0)
+        emits = active & ((~prefill) | (pos + n_pref >= plen))
+        temp = jnp.where(
+            emits,
+            jax.lax.bitcast_convert_type(ss_row[SS_TEMP_BITS],
+                                         jnp.float32),
+            jnp.float32(0.0))
+        # per-column keys: column j emits output index
+        # n_out + (j - base), base = n - 1 - kd (decode rows sample
+        # from column 0; a completing prefill from column n-1)
+        base = jnp.maximum(n - 1 - kd, 0)
+        idx = jnp.maximum(
+            ss_row[SS_N_OUT] + jnp.arange(chunk) - base, 0)
+        key0 = jax.random.PRNGKey(ss_row[SS_SEED])
+        keys = jax.vmap(lambda i: jax.random.fold_in(key0, i))(idx)
+        keys = jnp.where(emits, keys, jnp.zeros_like(keys))
+        return (tokens, n.astype(jnp.int32), temp, keys, emits,
+                kd.astype(jnp.int32))
 
     return jax.vmap(one)(slot_state)

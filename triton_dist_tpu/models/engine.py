@@ -46,7 +46,7 @@ def sample_token(logits, key=None, temperature: float = 0.0):
 
 def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
                      params, tokens, pool_k, pool_v, table, lengths,
-                     n_valid, temps, keys):
+                     n_valid, temps, keys, per_pos: bool = False):
     """THE per-rank serve-step computation (inside shard_map): one
     fixed-geometry (slots, chunk) forward over the paged pool's dense
     view, per-slot sampling, and the null-page-routed KV scatter.
@@ -55,7 +55,17 @@ def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
     plane's bit-identity discipline extends to the resident loop
     because both compile exactly this function on identical inputs
     (tests/test_serve_resident.py pins the loop-vs-standalone bitwise
-    equality end to end)."""
+    equality end to end).
+
+    per_pos=False: keys (K, 2) u32, the returned token is sampled at
+    column n_valid-1 only — the classic one-emission step. per_pos=True
+    (the spec-verify form, ISSUE 14): keys (K, C, 2) — EVERY column is
+    sampled under its own key and the returned token array is (K, C);
+    column j's token is what sequential decode would emit after
+    consuming tokens[:, :j+1] (the per-(seed, token-index) key stream
+    makes that literal, greedy AND sampled), which is exactly the
+    bit-identity oracle the longest-accepted-prefix rule needs
+    (triton_dist_tpu.spec.verify)."""
     cache = KVCache.dense_view(pool_k, pool_v, table, lengths)
     logits, new_cache = forward(
         cfg, params, tokens, cache, mode=mode, axis=axis,
@@ -64,12 +74,20 @@ def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
     bidx = jnp.arange(slots)[:, None]
     last = logits[jnp.arange(slots),
                   jnp.maximum(n_valid - 1, 0)]  # (K, V)
-    greedy = jnp.argmax(last, -1).astype(jnp.int32)
-    temp = jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.vmap(jax.random.categorical)(
-        keys, last / temp
-    ).astype(jnp.int32)
-    tok = jnp.where(temps > 0.0, sampled, greedy)
+    if per_pos:
+        greedy_all = jnp.argmax(logits, -1).astype(jnp.int32)  # (K, C)
+        temp = jnp.maximum(temps, 1e-6)[:, None, None]
+        sampled_all = jax.vmap(jax.vmap(jax.random.categorical))(
+            keys, logits / temp
+        ).astype(jnp.int32)
+        tok = jnp.where(temps[:, None] > 0.0, sampled_all, greedy_all)
+    else:
+        greedy = jnp.argmax(last, -1).astype(jnp.int32)
+        temp = jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(
+            keys, last / temp
+        ).astype(jnp.int32)
+        tok = jnp.where(temps > 0.0, sampled, greedy)
 
     # scatter this step's K/V rows back into the pool: valid
     # columns land on their table pages; padding columns are
@@ -235,7 +253,7 @@ class Engine:
     # -- serve step (batch-of-sequence-states contract) ---------------------
 
     def make_serve_step(self, slots: int, chunk: int, page: int,
-                        max_pages: int):
+                        max_pages: int, per_pos: bool = False):
         """ONE jit'd step function over a shared paged-KV pool — the
         contract the continuous-batching serve plane replays
         (triton_dist_tpu.serve; ref: the model_server loop replaying
@@ -269,18 +287,28 @@ class Engine:
         logits/temp under the slot's key — keys are derived host-side
         from (request seed, token index), so sampled generations are
         ALSO scheduling-invariant. Pool buffers are donated when the
-        engine was built with donate_cache=True."""
-        key = (slots, chunk, page, max_pages)
+        engine was built with donate_cache=True.
+
+        per_pos=True compiles the SPEC-VERIFY form of the same step
+        (ISSUE 14, triton_dist_tpu.spec): keys become (K, C, 2) — one
+        per column — and next_token becomes the (K, C) per-position
+        token matrix, column j sampled from the logits after consuming
+        tokens[:, :j+1] under its own key. One dispatch scores a whole
+        k-token draft per slot; the scheduler's longest-accepted-prefix
+        rule reads the matrix host-side (spec/verify.py). The caller
+        owns the length advance (accepted count, not n_valid)."""
+        key = (slots, chunk, page, max_pages, per_pos)
         fn = self._serve_cache.pop(key, None)
         if fn is None:
-            fn = self._build_serve_step(slots, chunk, page, max_pages)
+            fn = self._build_serve_step(slots, chunk, page, max_pages,
+                                        per_pos=per_pos)
             while len(self._serve_cache) >= self._gen_cache_max:
                 self._serve_cache.pop(next(iter(self._serve_cache)))
         self._serve_cache[key] = fn  # re-insert = LRU touch
         return fn
 
     def _build_serve_step(self, slots: int, chunk: int, page: int,
-                          max_pages: int):
+                          max_pages: int, per_pos: bool = False):
         cfg = self.cfg
         mode = self.decode_mode
         axis = self.axis
@@ -292,7 +320,7 @@ class Engine:
             return _serve_step_math(
                 cfg, mode, axis, slots, chunk, page, t_pool,
                 params, tokens, pool_k, pool_v, table, lengths,
-                n_valid, temps, keys)
+                n_valid, temps, keys, per_pos=per_pos)
 
         pool_spec = P(None, self.axis)
         return jax.jit(
@@ -326,7 +354,7 @@ class Engine:
                            max_pages: int, window: int,
                            ring_cap: int = 64,
                            prompt_cap: Optional[int] = None,
-                           poll_budget: int = 8):
+                           poll_budget: int = 8, spec_k: int = 0):
         """Compile the DEVICE-RESIDENT serve loop: up to `window` serve
         steps inside one executable — consume work-injection records at
         each step boundary, run the SAME per-rank step math as
@@ -370,7 +398,15 @@ class Engine:
         lane + one lane per slot), OUTERMOST last (the stats-then-trace
         strip order). Both are data-independent integer streams: tokens
         stay bitwise identical with telemetry on, and the bare loop's
-        program is untouched (zero-cost-off, tier-1-pinned)."""
+        program is untouched (zero-cost-off, tier-1-pinned).
+
+        spec_k > 0 compiles the SPEC-CAPABLE loop (ISSUE 14,
+        triton_dist_tpu.spec): KIND_VERIFY injection records stage up
+        to spec_k draft tokens on a decoding slot, the next step runs
+        the per-position verify row, and the longest accepted prefix
+        streams out as FLAG_SPEC output records (up to spec_k + 1 per
+        slot per step — out_cap scales accordingly). spec_k=0 keeps
+        today's program exactly (the branch is trace-time)."""
         from triton_dist_tpu.obs import stats as _ost
         from triton_dist_tpu.trace import events as _tev
 
@@ -384,13 +420,13 @@ class Engine:
         _tb = _tev.active_build()
         _ob = _ost.active_build()
         key = ("resident", slots, chunk, page, max_pages, window,
-               ring_cap, prompt_cap, poll_budget,
+               ring_cap, prompt_cap, poll_budget, spec_k,
                _tb.cap if _tb is not None else -1, _ob is not None)
         fn = self._serve_cache.pop(key, None)
         if fn is None:
             fn = self._build_resident_loop(slots, chunk, page, max_pages,
                                            window, ring_cap, prompt_cap,
-                                           poll_budget)
+                                           poll_budget, spec_k)
             while len(self._serve_cache) >= self._gen_cache_max:
                 self._serve_cache.pop(next(iter(self._serve_cache)))
         self._serve_cache[key] = fn  # re-insert = LRU touch
@@ -398,7 +434,8 @@ class Engine:
 
     def _build_resident_loop(self, slots: int, chunk: int, page: int,
                              max_pages: int, window: int, ring_cap: int,
-                             prompt_cap: int, poll_budget: int):
+                             prompt_cap: int, poll_budget: int,
+                             spec_k: int = 0):
         from triton_dist_tpu.mega import ring as mring
         from triton_dist_tpu.obs import stats as _ost
         from triton_dist_tpu.trace import events as _tev
@@ -416,12 +453,13 @@ class Engine:
         assert tb_build is None or slots <= 30, (
             f"traced resident loop supports <= 30 slots (got {slots}): "
             "the serve.step active mask is one i32")
-        # worst case: every step emits on every slot, plus one token-
-        # less retirement record per injection-ring retire
-        out_cap = window * slots + ring_cap
+        # worst case: every step emits on every slot — up to 1 + spec_k
+        # tokens each on a spec-verify step — plus one token-less
+        # retirement record per injection-ring retire
+        out_cap = window * slots * (1 + spec_k) + ring_cap
 
         def scatter_out(out_ring, out_count, step, rows_mask, slot_ids,
-                        toks, flags, reasons, reqids):
+                        toks, flags, reasons, reqids, spares=None):
             """Append one output record per set slot of rows_mask, in
             slot order; non-writers scatter to the trash row out_cap."""
             offs = jnp.cumsum(rows_mask) - rows_mask
@@ -429,7 +467,8 @@ class Engine:
             rec = jnp.stack([
                 out_count + offs + 1, slot_ids,
                 jnp.full_like(slot_ids, step), toks, flags, reasons,
-                reqids, jnp.zeros_like(slot_ids),
+                reqids,
+                jnp.zeros_like(slot_ids) if spares is None else spares,
             ], axis=-1)
             return (out_ring.at[rows].set(rec),
                     out_count + jnp.sum(rows_mask))
@@ -487,6 +526,117 @@ class Engine:
                 consumed2, ss, tb, ln, out, n_out, aux = boundary(
                     executed, consumed, ss, tb, ln, out, n_out, aux)
                 any_active = jnp.any(ss[:, mring.SS_ACTIVE] > 0)
+
+                def run_step_spec(ss, tb, ln, pk, pv, out, n_out, aux):
+                    """The spec-capable step (ISSUE 14, compiled only
+                    when spec_k > 0 — the plain loop's program is
+                    untouched): a decoding slot with a fresh KIND_VERIFY
+                    record runs a [last, d_1..d_kd] verify row through
+                    the per-position step math; the longest accepted
+                    prefix (plus the bonus token) is emitted — one
+                    output record per token, FLAG_SPEC-tagged, the
+                    first carrying kd — and the slot length advances by
+                    the EMITTED count (rejected positions hold masked
+                    garbage the next step overwrites, exactly the
+                    post-eviction stale-page class). Every emitted
+                    token is bitwise the sequential emission for its
+                    output index (per-column fold_in keys)."""
+                    step = step0 + executed
+                    active = ss[:, mring.SS_ACTIVE] > 0
+                    if tb_build is not None:
+                        mask = jnp.sum(jnp.where(
+                            active, jnp.int32(1) << slot_ids, 0))
+                        aux = dict(aux, t=_tev.mark(
+                            aux["t"], _tev.REGIONS["serve.step"],
+                            _tev.KIND_BEGIN, payload=step, aux=mask))
+                    tokens, n_valid, temps, keys, emits, kdv = \
+                        mring.slot_plan_spec(ring, ss, chunk,
+                                             max_pages, spec_k)
+                    tok_all, _last, pk, pv = _serve_step_math(
+                        cfg, mode, axis, slots, chunk, page, t_pool,
+                        params, tokens, pk, pv, tb, ln,
+                        n_valid, temps, keys, per_pos=True)
+                    prefill = ss[:, mring.SS_PHASE] == 0
+                    base = jnp.maximum(n_valid - 1 - kdv, 0)
+                    span = jnp.arange(spec_k + 1, dtype=jnp.int32)
+                    colsm = jnp.clip(base[:, None] + span[None, :],
+                                     0, chunk - 1)
+                    o = jnp.take_along_axis(tok_all, colsm, axis=1)
+                    d = jnp.take_along_axis(
+                        tokens, jnp.clip(colsm + 1, 0, chunk - 1),
+                        axis=1)
+                    accept = ((o == d)
+                              & (span[None, :] < kdv[:, None])
+                              ).astype(jnp.int32)
+                    acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+                    e = jnp.where(emits, acc + 1, 0)
+                    eos = ss[:, mring.SS_EOS]
+                    hits = (eos[:, None] > 0) & (o == eos[:, None] - 1)
+                    hit_in = hits & (span[None, :] < e[:, None])
+                    e = jnp.where(jnp.any(hit_in, axis=1),
+                                  jnp.argmax(hit_in, axis=1) + 1, e)
+                    rem = jnp.maximum(
+                        ss[:, mring.SS_MAX_NEW] - ss[:, mring.SS_N_OUT],
+                        0)
+                    e = jnp.minimum(e, rem)
+                    hit_eos = jnp.any(
+                        hits & (span[None, :] < e[:, None]), axis=1)
+                    n_out_new = ss[:, mring.SS_N_OUT] + e
+                    hit_len = (emits & (e > 0) & (~hit_eos)
+                               & (n_out_new >= ss[:, mring.SS_MAX_NEW]))
+                    finished = hit_eos | hit_len
+                    advance = jnp.where(prefill, n_valid, e)
+                    ln = ln + advance
+                    last_tok = jnp.take_along_axis(
+                        o, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+                    new_pos = ss[:, mring.SS_POS] + jnp.where(
+                        prefill, n_valid, 0)
+                    completing = (prefill
+                                  & (new_pos
+                                     >= ss[:, mring.SS_PROMPT_LEN])
+                                  & (ss[:, mring.SS_ACTIVE] > 0))
+                    ss = (ss
+                          .at[:, mring.SS_POS].set(new_pos)
+                          .at[:, mring.SS_PHASE].set(jnp.where(
+                              completing, 1, ss[:, mring.SS_PHASE]))
+                          .at[:, mring.SS_N_OUT].set(n_out_new)
+                          .at[:, mring.SS_LAST_TOK].set(jnp.where(
+                              e > 0, last_tok,
+                              ss[:, mring.SS_LAST_TOK]))
+                          .at[:, mring.SS_ACTIVE].set(jnp.where(
+                              finished, 0, ss[:, mring.SS_ACTIVE]))
+                          # staged verify records are one-shot
+                          .at[:, mring.SS_SPEC_K].set(0))
+                    spec_row = (kdv > 0).astype(jnp.int32)
+                    for j in range(spec_k + 1):
+                        m_j = (e > j).astype(jnp.int32)
+                        is_last = jnp.equal(e - 1, j)
+                        flags = (m_j * mring.FLAG_EMIT
+                                 + (is_last & finished).astype(jnp.int32)
+                                 * mring.FLAG_RETIRED
+                                 + m_j * spec_row * mring.FLAG_SPEC)
+                        reasons = jnp.where(
+                            is_last & hit_eos, mring.REASON_EOS,
+                            jnp.where(is_last & hit_len,
+                                      mring.REASON_LENGTH, 0))
+                        spare = spec_row * (
+                            kdv if j == 0 else jnp.zeros_like(kdv))
+                        out, n_out = scatter_out(
+                            out, n_out, step, m_j, slot_ids, o[:, j],
+                            flags, reasons, ss[:, mring.SS_REQID],
+                            spares=spare)
+                    if tb_build is not None:
+                        aux = dict(aux, t=_tev.mark(
+                            aux["t"], _tev.REGIONS["serve.step"],
+                            _tev.KIND_END, payload=step, aux=mask))
+                    if ob_build is not None:
+                        active_i = active.astype(jnp.int32)
+                        aux = dict(
+                            aux,
+                            s_steps=aux["s_steps"] + active_i,
+                            s_idle=aux["s_idle"] + 1 - active_i,
+                            s_emits=aux["s_emits"] + e)
+                    return 1, ss, tb, ln, pk, pv, out, n_out, aux
 
                 def run_step(ss, tb, ln, pk, pv, out, n_out, aux):
                     step = step0 + executed
@@ -559,7 +709,8 @@ class Engine:
 
                 (stepped, ss, tb, ln, pk, pv, out, n_out,
                  aux) = jax.lax.cond(
-                    any_active, run_step, idle_step,
+                    any_active,
+                    run_step_spec if spec_k else run_step, idle_step,
                     ss, tb, ln, pk, pv, out, n_out, aux)
                 if ob_build is not None:
                     aux = dict(aux, idlep=aux["idlep"] + 1 - stepped)

@@ -999,6 +999,145 @@ def choose_serve_mode(
     return "resident" if saved >= 0.02 * host_ms else "host"
 
 
+def expected_spec_tokens(accept_rate: float, k: int) -> float:
+    """Expected tokens emitted per spec-verify step under a per-token
+    acceptance probability `accept_rate`: 1 (the bonus token) plus the
+    expected accepted-prefix length of k geometric trials —
+    sum_{i=0..k} p^i = (1 - p^(k+1)) / (1 - p). k=0 -> 1.0 exactly
+    (spec off)."""
+    p = min(max(accept_rate, 0.0), 1.0)
+    if p >= 1.0:
+        return float(k + 1)
+    return (1.0 - p ** (k + 1)) / (1.0 - p)
+
+
+def estimate_spec_step_ms(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    k: int,
+    accept_rate: float,
+    slots: int = 4,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+) -> float:
+    """Per-EMITTED-TOKEN cost of spec-verify decode (ISSUE 14,
+    triton_dist_tpu.spec), acceptance-rate-parameterized: one verify
+    step runs the mixed-step roofline over slots * (k+1) tokens (every
+    decoding slot carries its k drafts) plus the per-step host
+    dispatch, and emits expected_spec_tokens(accept_rate, k) tokens
+    per slot. k=0 degenerates EXACTLY to the plain decode step's
+    per-token cost — the chooser's off-switch. While the step is
+    weight-stream-bound the k extra columns are nearly free, so any
+    nonzero acceptance wins; once compute-bound the wasted rejected
+    columns price in — the crossover choose_spec_k walks."""
+    step_ms = estimate_serve_step_ms(
+        num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+        vocab_loc, n_tokens=max(slots, 1) * (k + 1),
+        kv_tokens=kv_tokens, dtype=dtype, chip=chip,
+        attn_impl=attn_impl) + SERVE_DISPATCH_US * 1e-3
+    return step_ms / expected_spec_tokens(accept_rate, k)
+
+
+def choose_spec_k(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    accept_rate: float,
+    slots: int = 4,
+    kv_tokens: int = 0,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+    k_max: int = 8,
+    min_gain: float = 0.02,
+) -> int:
+    """The draft width for `serve.Scheduler(spec=SpecConfig(k=...))`:
+    the k in [0, k_max] minimizing the modeled per-emitted-token cost,
+    but 0 (spec OFF) unless the winner beats plain decode by at least
+    `min_gain` — speculative decode buys throughput with wasted
+    columns, so a within-noise win is not worth the scheduling
+    complexity. Monotone non-decreasing in accept_rate
+    (tests/test_spec.py pins it): low acceptance keeps k at 0, high
+    acceptance saturates toward k_max."""
+    args = (num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+            vocab_loc)
+    kw = dict(slots=slots, kv_tokens=kv_tokens, dtype=dtype, chip=chip,
+              attn_impl=attn_impl)
+    base = estimate_spec_step_ms(*args, k=0, accept_rate=accept_rate,
+                                 **kw)
+    best_k, best_ms = 0, base
+    for k in range(1, max(k_max, 0) + 1):
+        ms = estimate_spec_step_ms(*args, k=k,
+                                   accept_rate=accept_rate, **kw)
+        if ms < best_ms:
+            best_k, best_ms = k, ms
+    return best_k if best_ms <= (1.0 - min_gain) * base else 0
+
+
+# prefix-cache granularity: host-side trie cost per BLOCK per admission
+# (hash + dict walk on the scheduler thread — measured class, not
+# device work)
+PREFIX_NODE_US = 2.0
+
+
+def choose_prefix_block(
+    num_layers: int,
+    hidden: int,
+    inter_loc: int,
+    hq_loc: int,
+    hkv_loc: int,
+    head_dim: int,
+    vocab_loc: int,
+    page: int,
+    t_max: int,
+    prompt_len: Optional[int] = None,
+    slots: int = 4,
+    dtype=jnp.bfloat16,
+    chip: Optional[ChipSpec] = None,
+    attn_impl: str = "flash",
+) -> int:
+    """Token-block granularity for `serve.PrefixCache` (a multiple of
+    the pool page): small blocks match more of a shared prefix (the
+    expected truncation loss of block-aligned matching is ~block/2
+    tokens of re-prefill) but cost more host trie work per admission
+    (prompt_len / block nodes hashed + walked). The chooser minimizes
+    the modeled per-admission total — truncation priced at the
+    marginal prefill cost per token from the mixed-step roofline,
+    trie work at PREFIX_NODE_US per block — over page multiples up to
+    t_max. Fast steps (big models amortize nothing) push the block
+    up; slow per-token prefill pushes it down to the page."""
+    prompt_len = prompt_len or max(t_max // 2, page)
+    args = (num_layers, hidden, inter_loc, hq_loc, hkv_loc, head_dim,
+            vocab_loc)
+    kw = dict(kv_tokens=prompt_len, dtype=dtype, chip=chip,
+              attn_impl=attn_impl)
+    # marginal prefill cost per token: slope of the mixed step between
+    # 1 and 129 tokens (the weight stream cancels out of the slope)
+    t1 = estimate_serve_step_ms(*args, n_tokens=max(slots, 1), **kw)
+    t129 = estimate_serve_step_ms(*args, n_tokens=max(slots, 1) + 128,
+                                  **kw)
+    tok_us = max((t129 - t1) / 128.0 * 1e3, 1e-6)
+    best, best_cost = page, None
+    b = page
+    while b <= min(t_max, prompt_len) or b == page:
+        cost = (prompt_len / b) * PREFIX_NODE_US + (b / 2.0) * tok_us
+        if best_cost is None or cost < best_cost:
+            best, best_cost = b, cost
+        b *= 2
+    return best
+
+
 def choose_prefill_chunk(
     num_layers: int,
     hidden: int,

@@ -33,6 +33,7 @@ from triton_dist_tpu.serve.kv_pool import (  # noqa: F401
     PoolExhausted,
     pages_for,
 )
+from triton_dist_tpu.serve.prefix import PrefixCache  # noqa: F401
 from triton_dist_tpu.serve.queue import QueueFull, RequestQueue  # noqa: F401
 from triton_dist_tpu.serve.request import (  # noqa: F401
     Detokenizer,

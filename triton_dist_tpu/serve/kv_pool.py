@@ -18,14 +18,31 @@ garbage write can never land on another sequence's live page. The
 allocator therefore hands out pages [1, P) and `capacity` excludes the
 reserved page.
 
+Sharing (ISSUE 14, the prefix plane): pages are REFCOUNTED. A freshly
+allocated page has refcount 1 (its slot); `ref_pages` lets an external
+holder — the radix prefix cache, serve/prefix.py — retain pages past
+their slot's lifetime, and `share` admits a slot whose leading pages
+ARE another holder's pages (copy-on-write discipline: a shared page is
+only ever READ — the serve step writes at positions >= lengths, and a
+shared prefix always ends on a page boundary at/below lengths — and
+`cow` gives a slot a private copy the moment it would need to write
+one). `release`/`unref_pages` decrement; a page returns to the free
+list only at refcount 0, so eviction can never reclaim a page another
+slot or the cache still reads. `check()` generalizes the page-0
+null-page / leak / alias assertions: every page's refcount must equal
+its holder count (slot table occurrences + external holds), and the
+free list is exactly the refcount-0 pages.
+
 Host/device split: page bookkeeping (free list, per-slot page lists,
-lengths) is host-side numpy — the scheduler reads it every step — while
-k/v live on device and are donated through the step function.
+lengths, refcounts) is host-side numpy — the scheduler reads it every
+step — while k/v live on device and are donated through the step
+function (`cow` is the one bookkeeping op that also touches device
+state: it copies the page's k/v rows).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +99,11 @@ class KVPool:
         self.lengths = np.zeros((slots,), np.int32)
         self._free: List[int] = list(range(self.capacity, 0, -1))  # pop=1 first
         self._pages: List[Optional[List[int]]] = [None] * slots  # None=free
+        # refcount per page id (index 0 = the null page, always 0).
+        # refcount == number of holders: slot-table occurrences plus
+        # external holds (the prefix cache); 0 <=> on the free list.
+        self._refs = np.zeros((1 + self.capacity,), np.int32)
+        self._ext: Dict[int, int] = {}  # page -> external hold count
 
     # -- queries --------------------------------------------------------
 
@@ -89,10 +111,20 @@ class KVPool:
         return len(self._free)
 
     def used_pages(self, slot: Optional[int] = None) -> int:
+        """Pages held by a slot (or all slots). A page shared across
+        slots counts once per holder — this is table occupancy, not
+        distinct-page pressure (free_pages reads the latter)."""
         if slot is not None:
             ps = self._pages[slot]
             return 0 if ps is None else len(ps)
         return sum(len(p) for p in self._pages if p is not None)
+
+    def refcount(self, page: int) -> int:
+        return int(self._refs[page])
+
+    def shared_pages(self) -> int:
+        """Distinct pages with refcount > 1 (the sharing win)."""
+        return int(np.sum(self._refs > 1))
 
     def free_slot(self) -> Optional[int]:
         for s, p in enumerate(self._pages):
@@ -101,26 +133,55 @@ class KVPool:
         return None
 
     def check(self) -> None:
-        """Allocator invariants (leak/aliasing guard): every page is in
-        exactly one place — one slot's list or the free list — and the
-        null page is in neither."""
+        """Allocator invariants (leak/alias/refcount guard): every
+        page's refcount equals its holder count (slot-table occurrences
+        + external holds), the free list is exactly the refcount-0
+        pages (each once), a page appears at most once per slot, and
+        the null page is held nowhere."""
         held = [pg for ps in self._pages if ps is not None for pg in ps]
-        all_pages = held + self._free
-        assert 0 not in all_pages, "null page leaked into the allocator"
-        assert len(all_pages) == len(set(all_pages)), (
-            "page aliased across slots/free list"
+        assert 0 not in held and 0 not in self._free, (
+            "null page leaked into the allocator"
         )
-        assert sorted(all_pages) == list(range(1, self.capacity + 1)), (
-            f"page leak: {len(all_pages)} accounted, "
-            f"{self.capacity} allocatable"
+        assert 0 not in self._ext and all(
+            v > 0 for v in self._ext.values()), (
+            f"malformed external holds {self._ext}"
         )
         for s, ps in enumerate(self._pages):
             if ps is not None:
+                assert len(ps) == len(set(ps)), (
+                    f"page aliased within slot {s}: {ps}"
+                )
                 assert list(self.table[s, :len(ps)]) == ps, (
                     f"slot {s} table drifted from its page list"
                 )
+        assert len(self._free) == len(set(self._free)), (
+            "page aliased within the free list"
+        )
+        holders = np.zeros_like(self._refs)
+        for pg in held:
+            holders[pg] += 1
+        for pg, n in self._ext.items():
+            holders[pg] += n
+        assert np.array_equal(holders, self._refs), (
+            f"refcount drift: holders {np.flatnonzero(holders != self._refs)}"
+        )
+        free_set = set(self._free)
+        for pg in range(1, self.capacity + 1):
+            if self._refs[pg] == 0:
+                assert pg in free_set, f"page {pg} leaked (ref 0, not free)"
+            else:
+                assert pg not in free_set, (
+                    f"page {pg} aliased: refcount {self._refs[pg]} but "
+                    "on the free list"
+                )
 
     # -- lifecycle ------------------------------------------------------
+
+    def _alloc(self, need: int) -> List[int]:
+        assert need <= len(self._free)
+        new = [self._free.pop() for _ in range(need)]
+        self._refs[new] = 1
+        return new
 
     def admit(self, slot: int, n_tokens: int) -> None:
         """Claim `slot` and allocate pages for an n_tokens history
@@ -136,9 +197,99 @@ class KVPool:
             raise PoolExhausted(
                 f"need {need} pages, {len(self._free)} free"
             )
-        self._pages[slot] = [self._free.pop() for _ in range(need)]
+        self._pages[slot] = self._alloc(need)
         self.table[slot, :need] = self._pages[slot]
         self.lengths[slot] = 0
+
+    def share(self, slot: int, shared: Sequence[int],
+              n_tokens: int) -> None:
+        """Claim `slot` with its LEADING pages shared from another
+        holder (the prefix-cache hit path): each page of `shared` is
+        increfed into the slot's table, fresh pages are allocated for
+        the rest of an n_tokens history, and the slot length starts at
+        the shared coverage (len(shared) * page tokens of KV are
+        already live in those pages). All-or-nothing like admit.
+
+        COW discipline: the serve step writes at positions >= lengths,
+        and the shared pages cover exactly [0, lengths) — a shared page
+        is never written through this slot (cow() exists for callers
+        that break that alignment)."""
+        assert self._pages[slot] is None, f"slot {slot} already in use"
+        shared = [int(p) for p in shared]
+        assert all(self._refs[p] >= 1 for p in shared), (
+            f"sharing unheld page(s) {shared}"
+        )
+        assert len(shared) == len(set(shared)), f"aliased share {shared}"
+        need_total = max(pages_for(n_tokens, self.page), 1,
+                         len(shared))
+        assert need_total <= self.max_pages, (
+            f"{n_tokens} tokens need {need_total} pages > table width "
+            f"{self.max_pages}"
+        )
+        fresh = need_total - len(shared)
+        if fresh > len(self._free):
+            raise PoolExhausted(
+                f"need {fresh} fresh pages, {len(self._free)} free"
+            )
+        self._refs[shared] += 1
+        ps = shared + self._alloc(fresh)
+        self._pages[slot] = ps
+        self.table[slot, :len(ps)] = ps
+        self.lengths[slot] = len(shared) * self.page
+
+    def ref_pages(self, pages: Sequence[int]) -> None:
+        """External hold (the prefix cache retaining pages): increfs
+        each page so release()/eviction can never reclaim it."""
+        for p in pages:
+            p = int(p)
+            assert 1 <= p <= self.capacity and self._refs[p] >= 1, (
+                f"external ref of unheld page {p}"
+            )
+            self._refs[p] += 1
+            self._ext[p] = self._ext.get(p, 0) + 1
+
+    def unref_pages(self, pages: Sequence[int]) -> int:
+        """Drop an external hold; pages reaching refcount 0 return to
+        the free list. Returns the number of pages actually freed."""
+        freed = 0
+        for p in pages:
+            p = int(p)
+            assert self._ext.get(p, 0) >= 1, (
+                f"external unref of page {p} without a hold"
+            )
+            self._ext[p] -= 1
+            if self._ext[p] == 0:
+                del self._ext[p]
+            self._refs[p] -= 1
+            assert self._refs[p] >= 0
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def cow(self, slot: int, page_idx: int) -> int:
+        """Copy-on-write: give `slot` a PRIVATE copy of its
+        `page_idx`-th page. A no-op (returns the page) when the slot is
+        already the only holder; otherwise allocates a fresh page,
+        copies the k/v rows on device, swaps it into the slot's table,
+        and drops this slot's hold on the shared original. Returns the
+        (possibly new) page id; raises PoolExhausted when no page is
+        free for the copy."""
+        ps = self._pages[slot]
+        assert ps is not None, f"slot {slot} is not admitted"
+        assert 0 <= page_idx < len(ps)
+        old = ps[page_idx]
+        if self._refs[old] == 1:
+            return old
+        if not self._free:
+            raise PoolExhausted("no free page for the COW copy")
+        (new,) = self._alloc(1)
+        self.k = self.k.at[:, :, new].set(self.k[:, :, old])
+        self.v = self.v.at[:, :, new].set(self.v[:, :, old])
+        ps[page_idx] = new
+        self.table[slot, page_idx] = new
+        self._refs[old] -= 1
+        return new
 
     def ensure(self, slot: int, upto_tokens: int) -> bool:
         """Grow `slot`'s allocation to cover `upto_tokens` (all-or-
@@ -155,17 +306,24 @@ class KVPool:
         )
         if need > len(self._free):
             return False
-        new = [self._free.pop() for _ in range(need)]
+        new = self._alloc(need)
         self.table[slot, len(ps):len(ps) + need] = new
         ps.extend(new)
         return True
 
     def release(self, slot: int) -> None:
-        """Free `slot` and return its pages (free-on-finish / eviction).
-        Double-free is an assertion, not a silent no-op."""
+        """Free `slot`: drop its hold on every page (free-on-finish /
+        eviction). Pages still held elsewhere — shared with another
+        slot or retained by the prefix cache — survive; only
+        refcount-0 pages return to the free list. Double-free is an
+        assertion, not a silent no-op."""
         ps = self._pages[slot]
         assert ps is not None, f"double free of slot {slot}"
-        self._free.extend(reversed(ps))
+        for p in reversed(ps):
+            self._refs[p] -= 1
+            assert self._refs[p] >= 0, f"over-release of page {p}"
+            if self._refs[p] == 0:
+                self._free.append(p)
         self._pages[slot] = None
         self.table[slot] = 0
         self.lengths[slot] = 0

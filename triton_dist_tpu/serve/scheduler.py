@@ -53,6 +53,7 @@ from triton_dist_tpu.obs.health import SLOMonitor
 from triton_dist_tpu.obs.recorder import FlightRecorder
 from triton_dist_tpu.obs.registry import Registry
 from triton_dist_tpu.serve.kv_pool import KVPool, PoolExhausted, pages_for
+from triton_dist_tpu.serve.prefix import PrefixCache
 from triton_dist_tpu.serve.queue import RequestQueue
 from triton_dist_tpu.serve.request import (
     LATENCY_BUCKETS,
@@ -63,6 +64,7 @@ from triton_dist_tpu.serve.request import (
     summarize,
 )
 from triton_dist_tpu.serve.worker import ResidentWorker, Worker
+from triton_dist_tpu.spec.verify import accept_tokens, draft_cap
 
 
 def _default_page(max_len: int) -> int:
@@ -92,6 +94,9 @@ class Scheduler:
         resident=False,
         window: Optional[int] = None,
         ring_cap: Optional[int] = None,
+        spec=None,
+        prefix_cache=False,
+        prefix_block: Optional[int] = None,
     ):
         page = page or _default_page(engine.max_len)
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
@@ -121,6 +126,44 @@ class Scheduler:
             )
             chunk = max(1, min(chunk, self.pool.t_max))
         self.chunk = chunk
+        # -- speculative decoding (ISSUE 14, triton_dist_tpu.spec): a
+        # SpecConfig turns decoding slots into k-token verify rows —
+        # host loop via the per-position serve step, resident via
+        # KIND_VERIFY ring records. k=0 (or spec=None) is OFF.
+        self.spec = spec if (spec is not None
+                             and getattr(spec, "k", 0) > 0) else None
+        if self.spec is not None:
+            assert self.spec.k + 1 <= self.chunk, (
+                f"spec k={self.spec.k} needs k+1 <= chunk "
+                f"({self.chunk}): the verify row is [last, d_1..d_k]")
+        # -- radix prefix cache (ISSUE 14, serve/prefix.py): admission
+        # matches the prompt against cached token blocks and skips
+        # prefill for the hit (KVPool.share — copy-on-write refcounted
+        # pages); finished prefills index their prompt blocks back in
+        self.prefix = None
+        if prefix_cache:
+            if isinstance(prefix_cache, PrefixCache):
+                # a PrefixCache is bound to its pool, and this
+                # scheduler's pool was just constructed above — no
+                # caller-built instance can reference it
+                raise ValueError(
+                    "pass prefix_cache=True (+ prefix_block) and let "
+                    "the scheduler build the cache over its own pool")
+            if prefix_block is None:
+                from triton_dist_tpu.perf_model import (
+                    choose_prefix_block,
+                )
+
+                cfg = engine.cfg
+                n = int(engine.mesh.shape[engine.axis])
+                prefix_block = choose_prefix_block(
+                    cfg.num_layers, cfg.hidden_size,
+                    cfg.intermediate_size // n,
+                    cfg.num_q_heads // n, cfg.num_kv_heads // n,
+                    cfg.head_dim, cfg.vocab_size // n,
+                    page=page, t_max=self.pool.t_max,
+                    dtype=cfg.dtype)
+            self.prefix = PrefixCache(self.pool, block=prefix_block)
         # -- execution mode: the host loop (one dispatch per step) or
         # the megakernel-resident window (ISSUE 12: one dispatch per
         # `window` steps, work injected through mega.ring). "auto"
@@ -161,7 +204,8 @@ class Scheduler:
                     kv_tokens=self.pool.t_max, dtype=cfg.dtype)
             self.worker = ResidentWorker(
                 engine, self.pool, chunk, window=window,
-                ring_cap=ring_cap)
+                ring_cap=ring_cap,
+                spec_k=self.spec.k if self.spec is not None else 0)
         else:
             # under "auto" the chooser may legitimately pick the host
             # loop: the caller's window/ring_cap are then simply moot,
@@ -169,7 +213,8 @@ class Scheduler:
             assert auto or (window is None and ring_cap is None), (
                 "window/ring_cap configure the resident mode — pass "
                 "resident=True (or 'auto')")
-            self.worker = Worker(engine, self.pool, chunk)
+            self.worker = Worker(engine, self.pool, chunk,
+                                 per_pos=self.spec is not None)
         # `queue or ...` would silently DISCARD a custom queue that is
         # currently empty (RequestQueue defines __len__, and an empty
         # queue is falsy) — the admission-control settings a caller
@@ -200,6 +245,10 @@ class Scheduler:
         for name in ("serve_req_queued_us", "serve_req_prefill_us",
                      "serve_req_decode_us"):
             self.obs.declare_histogram(name, *LATENCY_BUCKETS)
+        # spec acceptance-rate histogram (ISSUE 14): one observation
+        # per verify step, accepted/proposed in [0, 1] (a 0.0 lands in
+        # the first bucket — the ladder's lo is the resolution floor)
+        self.obs.declare_histogram("spec_accept_rate", 0.01, 1.0, 1.25)
         # -- request-scoped attribution (ISSUE 13): per-step / per-
         # window slot->request history, the substrate trace/ledger.py
         # folds device time through. Bounded: a long-running server
@@ -286,36 +335,57 @@ class Scheduler:
         if not self.active:
             return False
 
+        spec_on = self.spec is not None
         K, C = self.pool.slots, self.chunk
         tokens = np.zeros((K, C), np.int32)
         n_valid = np.zeros((K,), np.int32)
         temps = np.zeros((K,), np.float32)
-        keys = np.zeros((K, 2), np.uint32)
-        plans = []  # (slot, req, n, completes_chunk)
+        keys = np.zeros((K, C, 2) if spec_on else (K, 2), np.uint32)
+        plans = []  # (slot, req, n, completes_chunk, drafts)
 
         for slot in sorted(self.active):
             req = self.active.get(slot)
             if req is None:  # evicted by an earlier slot's _room call
                 continue
             hist = req.history()
+            drafts: list = []
             if req.state is RequestState.PREFILL:
                 n = min(C, len(hist) - req.pos)
                 if not self._room(slot, req, req.pos + n):
                     continue  # stalled this step
                 tokens[slot, :n] = hist[req.pos:req.pos + n]
                 emits = req.pos + n == len(hist)
-            else:  # DECODE
-                n = 1
-                if not self._room(slot, req, len(hist) + 1):
+            else:  # DECODE — possibly a spec-verify row (ISSUE 14)
+                if spec_on:
+                    cap = draft_cap(self.spec.k, C, len(hist),
+                                    len(req.out_tokens),
+                                    req.max_new_tokens, self.pool.t_max)
+                    if cap > 0:
+                        drafts = [int(t) for t in
+                                  self.spec.draft.propose(hist, cap)
+                                  ][:cap]
+                n = 1 + len(drafts)
+                if not self._room(slot, req, len(hist) + n):
                     continue
                 tokens[slot, 0] = hist[-1]
+                if drafts:
+                    tokens[slot, 1:n] = drafts
                 emits = True
             n_valid[slot] = n
             if emits:
                 temps[slot] = req.temperature
-                keys[slot] = self.worker.key_for(req.seed,
-                                                 len(req.out_tokens))
-            plans.append((slot, req, n, emits))
+                if spec_on:
+                    # per-column keys: the verify row's column j emits
+                    # output index n_out + j (spec/verify.verify_keys'
+                    # derivation, inlined for the plan loop)
+                    base = n - 1 - len(drafts)
+                    for j in range(len(drafts) + 1):
+                        keys[slot, base + j] = self.worker.key_for(
+                            req.seed, len(req.out_tokens) + j)
+                else:
+                    keys[slot] = self.worker.key_for(
+                        req.seed, len(req.out_tokens))
+            plans.append((slot, req, n, emits, drafts))
 
         # a later slot's page demand may have evicted an earlier,
         # already-planned request (_room): scrub its row from the step
@@ -350,19 +420,57 @@ class Scheduler:
         self._record_history({
             "kind": "step", "step": step_idx, "t0": t0, "t1": t1,
             "slots": {s: (r.request_id, r.state.value, n)
-                      for s, r, n, _e in plans},
+                      for s, r, n, _e, _d in plans},
         })
 
-        for slot, req, n, emits in plans:
+        emit_plan: dict = {}
+        if spec_on:
+            # the per-position step did not advance lengths: apply the
+            # longest-accepted-prefix rule first, advance by the
+            # EMITTED count per verify row (n_valid for prefill rows),
+            # then stream the emissions
+            advance = np.array(n_valid, np.int32)
+            for slot, req, n, emits, drafts in plans:
+                if req.state is RequestState.PREFILL:
+                    continue
+                out = accept_tokens(
+                    drafts, toks[slot, :n], eos_id=req.eos_id,
+                    max_emit=req.max_new_tokens - len(req.out_tokens))
+                emit_plan[slot] = out
+                advance[slot] = len(out)
+                if drafts:
+                    acc = max(len(out) - 1, 0)
+                    req.n_spec_steps += 1
+                    self.obs.inc("spec_proposed", len(drafts))
+                    self.obs.inc("spec_accepted", acc)
+                    self.obs.observe("spec_accept_rate",
+                                     acc / len(drafts))
+            self.worker.advance_lengths(advance)
+
+        for slot, req, n, emits, drafts in plans:
             req.last_active_step = self.worker.n_steps
             req.n_device_steps += 1
             if req.state is RequestState.PREFILL:
                 req.n_prefill_chunks += 1
                 req.pos += n
                 if emits:
+                    if self.prefix is not None:
+                        self._prefix_insert(req, slot)
                     self._phase(req, "decode")
                     req.state = RequestState.DECODE
-                    self._emit(req, int(toks[slot]))
+                    self._emit(req, int(toks[slot, n - 1] if spec_on
+                                        else toks[slot]))
+            elif spec_on:
+                if drafts:
+                    # the verify step's wall, split across the step's
+                    # occupants — the ledger's spec_verify sub-bucket
+                    # of decode (trace/ledger.py)
+                    req.spec_verify_ns += int(
+                        (t1 - t0) / max(len(plans), 1))
+                for t in emit_plan[slot]:
+                    if req.done:
+                        break  # eos/length retired mid-batch
+                    self._emit(req, int(t))
             else:
                 self._emit(req, int(toks[slot]))
         self._observe_step()
@@ -409,12 +517,13 @@ class Scheduler:
         exponential-backoff retries, then quarantine of the suspected
         poisoner. Returns the per-slot tokens, or None when the step
         was abandoned this round (survivors rerun next step)."""
+        body = (self.worker.step_spec if self.worker.per_pos
+                else self.worker.step)
         toks, err = self._attempt_with_backoff(
-            "step",
-            lambda: self.worker.step(tokens, n_valid, temps, keys))
+            "step", lambda: body(tokens, n_valid, temps, keys))
         if err is None:
             return toks
-        victim = max((req for _slot, req, _n, _e in plans),
+        victim = max((req for _slot, req, _n, _e, _d in plans),
                      key=lambda r: r.admit_seq)
         self._quarantine(victim, err)
         return None
@@ -429,6 +538,8 @@ class Scheduler:
         "Device-resident serving")."""
         self._reap_cancelled_resident()
         self._admit_resident()
+        if self.spec is not None:
+            self._inject_spec_resident()
         if not self.active and self.worker.pending_records() == 0:
             return False
         t0 = time.perf_counter_ns()
@@ -526,33 +637,70 @@ class Scheduler:
                 return
             slot = self.pool.free_slot()
             total = len(req.history()) + req.max_new_tokens
-            need = max(pages_for(total, self.pool.page), 1)
-            if slot is None or self.pool.free_pages() < need:
+            if slot is None:
+                return
+            # the prefix match + cache pressure valve; no eviction in
+            # resident mode, so the cache is the ONLY valve
+            m, mpages, need = self._reclaim_and_rematch(req, total)
+            if self.pool.free_pages() < need:
                 return
             self.queue.pop()
             try:
-                self.pool.admit(slot, len(req.history()))
-                ok = self.pool.ensure(slot, total)
-                assert ok, "free_pages said yes, ensure said no"
+                if m > 0:
+                    self.pool.share(slot, mpages, total)
+                else:
+                    self.pool.admit(slot, len(req.history()))
+                    ok = self.pool.ensure(slot, total)
+                    assert ok, "free_pages said yes, ensure said no"
             except PoolExhausted:
                 self.queue.requeue(req)
                 return
             req.slot = slot
-            req.pos = 0
+            req.pos = m
+            req.prefix_len = m
             req.state = RequestState.PREFILL
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
             self.active[slot] = req
             self.obs.inc("serve_admitted")
+            self._note_prefix(m, mpages)
             self._phase(req, "prefill")
             self.worker.admit(
                 slot, req.history(), req.max_new_tokens,
-                req.temperature, req.seed, req.eos_id, req.request_id)
+                req.temperature, req.seed, req.eos_id, req.request_id,
+                prefix=m)
             # inject-wait bookkeeping (ISSUE 13): the record's seq, so
             # _observe_window can stamp the admit -> device-pickup wait
             req._t_admit_ns = time.perf_counter_ns()
             req._admit_rec_seq = self.worker.ring.published
             self._pending_inject[req.request_id] = req
+
+    def _inject_spec_resident(self) -> None:
+        """Spec-verify injection, resident form (ISSUE 14): one
+        KIND_VERIFY record per decoding slot per window, drafted from
+        the tokens drained so far. The device verifies it at the
+        window's FIRST step (its state still matches the record's
+        n_out there) and plain-decodes the rest of the window — the
+        per-window cadence is the resolution the ring contract gives
+        the host; every accepted token is still bitwise the sequential
+        emission (the per-column key stream travels with the step, not
+        the record)."""
+        for slot, req in self.active.items():
+            if req.done or req.state is not RequestState.DECODE:
+                continue
+            if not self.worker.can_inject():
+                return
+            hist = req.history()
+            cap = draft_cap(self.spec.k, self.chunk, len(hist),
+                            len(req.out_tokens), req.max_new_tokens,
+                            self.pool.t_max)
+            if cap <= 0:
+                continue
+            drafts = [int(t) for t in
+                      self.spec.draft.propose(hist, cap)][:cap]
+            if drafts:
+                self.worker.inject_verify(
+                    slot, req.request_id, len(req.out_tokens), drafts)
 
     def _reap_cancelled_resident(self) -> None:
         """Cancellation, resident form: the retirement travels as a
@@ -650,12 +798,22 @@ class Scheduler:
             REASON_LENGTH,
         )
 
+        # spec-verify roll-up (ISSUE 14): FLAG_SPEC records group by
+        # (slot, step) — the first carries the proposed count, every
+        # further one is an accepted draft riding the same step
+        spec_groups: dict = {}
         for rec in records:
             if rec.emitted or rec.retired:
                 # first emission = prefill done (the device no longer
                 # streams from the admission row); retirement likewise
                 # — either way the pinned ring row is reclaimable
                 self.worker.unpin(rec.req_id)
+            if rec.spec and rec.emitted:
+                g = spec_groups.setdefault((rec.slot, rec.step),
+                                           [0, -1])
+                g[1] += 1
+                if rec.spec_k:
+                    g[0] = rec.spec_k
             req = self.active.get(rec.slot)
             if req is None or req.request_id != rec.req_id:
                 continue  # stale record for a slot already turned over
@@ -666,16 +824,25 @@ class Scheduler:
                 # emission here keeps the TokenStream end-of-stream
                 # sentinel terminal
                 if req.state is RequestState.PREFILL:
+                    if self.prefix is not None:
+                        self._prefix_insert(req, rec.slot)
                     self._phase(req, "decode")
                     req.state = RequestState.DECODE
                     # the full prefill ran on device: credit its chunk
-                    # steps now (resident mode never evicts, so the
-                    # history length here is exactly what was staged)
-                    chunks = -(-len(req.history()) // self.chunk)
+                    # steps now (resident mode never evicts, so what
+                    # was staged is history minus the prefix-cache hit)
+                    chunks = -(-(len(req.history()) - req.prefix_len)
+                               // self.chunk)
                     req.n_prefill_chunks += chunks
                     req.n_device_steps += chunks
+                elif rec.spec and req.out_tokens \
+                        and rec.step == req._last_spec_step:
+                    pass  # same verify step: one device step, n tokens
                 else:
                     req.n_device_steps += 1
+                    if rec.spec:
+                        req.n_spec_steps += 1
+                        req._last_spec_step = rec.step
                 req.last_active_step = self.worker.n_steps
                 piece = (self.detok.piece(rec.token)
                          if self.detok else None)
@@ -702,6 +869,12 @@ class Scheduler:
                 else:  # REASON_HOST: an injected cancel came back
                     self._retire(req, "cancelled",
                                  RequestState.CANCELLED)
+        for (_slot, _step), (kd, extra) in spec_groups.items():
+            if kd > 0:
+                acc = max(extra, 0)
+                self.obs.inc("spec_proposed", kd)
+                self.obs.inc("spec_accepted", acc)
+                self.obs.observe("spec_accept_rate", acc / kd)
 
     def _count_guard_trips(self, err) -> None:
         """Guard-trip counters by wait site (the decoded rows a
@@ -857,6 +1030,19 @@ class Scheduler:
         out["active_slots"] = len(self.active)
         out["pool_free_pages"] = self.pool.free_pages()
         out["pool_used_pages"] = self.pool.used_pages()
+        # prefix + spec planes (ISSUE 14) — always present (0 when the
+        # plane is off) so dashboards never lose the keys
+        out["prefix_hits"] = snap.get("serve_prefix_hits", 0)
+        out["prefix_misses"] = snap.get("serve_prefix_misses", 0)
+        out["prefix_pages_shared"] = snap.get(
+            "serve_prefix_pages_shared", 0)
+        out["prefix_blocks"] = (self.prefix.n_blocks()
+                                if self.prefix is not None else 0)
+        out["spec_proposed"] = snap.get("spec_proposed", 0)
+        out["spec_accepted"] = snap.get("spec_accepted", 0)
+        out["spec_accept_rate"] = round(
+            out["spec_accepted"] / out["spec_proposed"], 4
+        ) if out["spec_proposed"] else 0.0
         if self.resident:
             out["resident_windows"] = snap.get(
                 "serve_resident_windows", 0)
@@ -916,6 +1102,20 @@ class Scheduler:
     def _room(self, slot: int, req: Request, upto: int) -> bool:
         if self.pool.ensure(slot, upto):
             return True
+        if self.prefix is not None:
+            # pool pressure reclaims UNSHARED cached blocks before any
+            # live request is evicted; blocks whose pages a live slot
+            # still reads are skipped (the refcount>1 refusal —
+            # serve/prefix.py, chaos cell pool_pressure_shared).
+            # Reclaim only the DEFICIT beyond the free list — the
+            # admission paths' rule — so mild pressure never thrashes
+            # the whole cache
+            need = (pages_for(upto, self.pool.page)
+                    - self.pool.used_pages(slot)
+                    - self.pool.free_pages())
+            if self.prefix.reclaim(need) > 0 \
+                    and self.pool.ensure(slot, upto):
+                return True
         victim = self._pick_victim(req)
         while victim is not None:
             self._evict(victim, site="growth")
@@ -923,6 +1123,13 @@ class Scheduler:
                 return True
             victim = self._pick_victim(req)
         return False
+
+    def _prefix_insert(self, req: Request, slot: int) -> None:
+        """Index a freshly completed prefill's prompt blocks (the
+        PREFILL -> DECODE transition, host loop and resident drain
+        alike): the trie increfs the slot's pages — no copy — so the
+        next templated prompt admission shares them."""
+        self.prefix.insert(req.prompt, self.pool.table[slot])
 
     @staticmethod
     def _victim_order(a: Request):
@@ -943,13 +1150,57 @@ class Scheduler:
         ]
         return min(cands, key=self._victim_order) if cands else None
 
+    def _match_prefix(self, req: Request):
+        """Trie lookup for an admission: (matched tokens, shared
+        pages) — (0, []) without a cache. The hit/miss accounting
+        happens at the ADMISSION that uses the match (not here — a
+        stalled admission retries the lookup every round)."""
+        if self.prefix is None:
+            return 0, []
+        return self.prefix.match(req.history())
+
+    def _reclaim_and_rematch(self, req: Request, total: int):
+        """The prefix-cache pressure valve shared by BOTH admission
+        paths: match, and if the fresh-page need outruns the free
+        list, reclaim the DEFICIT from unshared cached blocks and
+        RE-match — the reclaim may have dropped nodes on the matched
+        path itself (an unshared hit is a valid LRU victim), and stale
+        mpages would share freed pages. Returns (m, mpages,
+        fresh_need) for a `total`-token allocation."""
+        m, mpages = self._match_prefix(req)
+        need = max(pages_for(total, self.pool.page), 1) - len(mpages)
+        if self.prefix is not None and self.pool.free_pages() < need:
+            self.prefix.reclaim(need - self.pool.free_pages())
+            m, mpages = self._match_prefix(req)
+            need = max(pages_for(total, self.pool.page),
+                       1) - len(mpages)
+        return m, mpages, need
+
+    def _note_prefix(self, m: int, mpages) -> None:
+        """Hit/miss accounting for one successful admission."""
+        if self.prefix is None:
+            return
+        if m > 0:
+            self.prefix.hits += 1
+            self.prefix.tokens_reused += m
+            self.obs.inc("serve_prefix_hits")
+            self.obs.inc("serve_prefix_pages_shared", len(mpages))
+        else:
+            self.prefix.misses += 1
+            self.obs.inc("serve_prefix_misses")
+
     def _admit(self) -> None:
         while len(self.active) < self.max_active:
             req = self.queue.peek()
             if req is None:
                 return
             slot = self.pool.free_slot()
-            need = max(pages_for(len(req.history()), self.pool.page), 1)
+            m, mpages, need = 0, [], 1
+            if slot is not None:
+                # the prefix match + cache pressure valve (reclaim
+                # unshared blocks before touching live requests)
+                m, mpages, need = self._reclaim_and_rematch(
+                    req, len(req.history()))
             if slot is None or self.pool.free_pages() < need:
                 # a strictly higher-priority arrival may preempt
                 cands = [a for a in self.active.values()
@@ -961,17 +1212,26 @@ class Scheduler:
                 continue
             self.queue.pop()
             try:
-                self.pool.admit(slot, len(req.history()))
+                if m > 0:
+                    self.pool.share(slot, mpages, len(req.history()))
+                else:
+                    self.pool.admit(slot, len(req.history()))
             except PoolExhausted:  # raced with nothing; be safe
                 self.queue.requeue(req)
                 return
             req.slot = slot
-            req.pos = 0
+            # a prefix hit resumes prefill AFTER the cached coverage:
+            # the shared pages already hold positions [0, m), and the
+            # emitted stream stays bitwise a cold run's (docs/
+            # serving.md "Prefix reuse" — the tier-1-pinned property)
+            req.pos = m
+            req.prefix_len = m
             req.state = RequestState.PREFILL
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
             self.active[slot] = req
             self.obs.inc("serve_admitted")
+            self._note_prefix(m, mpages)
             self._phase(req, "prefill")
 
     def _evict(self, req: Request, site: str = "growth") -> None:
@@ -979,6 +1239,7 @@ class Scheduler:
         del self.active[req.slot]
         req.slot = -1
         req.pos = 0
+        req.prefix_len = 0  # re-admission re-matches the trie
         req.n_evictions += 1
         self.obs.inc("serve_evicted", site=site)
         now = time.perf_counter_ns()

@@ -46,12 +46,15 @@ def sampling_key(seed: int, token_index: int) -> np.ndarray:
 
 
 class Worker:
-    def __init__(self, engine, pool: KVPool, chunk: int):
+    def __init__(self, engine, pool: KVPool, chunk: int,
+                 per_pos: bool = False):
         self.engine = engine
         self.pool = pool
         self.chunk = chunk
+        self.per_pos = per_pos
         self._fn = engine.make_serve_step(pool.slots, chunk, pool.page,
-                                          pool.max_pages)
+                                          pool.max_pages,
+                                          per_pos=per_pos)
         self.n_steps = 0
 
     key_for = staticmethod(sampling_key)
@@ -70,6 +73,10 @@ class Worker:
         FaultPlan's FailStep(at_step=n_steps) injects the failure here
         (n_steps counts SUCCESSFUL steps, so `times` controls how many
         consecutive retries the injected fault survives)."""
+        assert not self.per_pos, (
+            "a per-position (spec) worker runs step_spec + "
+            "advance_lengths — the scheduler owns the accepted-count "
+            "advance")
         plan = _fplan.active()
         if plan is not None:
             err = plan.step_fault(self.n_steps)
@@ -90,6 +97,46 @@ class Worker:
         self.n_steps += 1
         return np.asarray(tok)
 
+    def step_spec(self, tokens: np.ndarray, n_valid: np.ndarray,
+                  temps: np.ndarray, keys: np.ndarray) -> np.ndarray:
+        """The per-position (spec-capable) step: keys (K, C, 2) — one
+        per column — and the return is the full (K, C) per-position
+        token matrix (ISSUE 14, spec/verify.py). Pool LENGTHS ARE NOT
+        ADVANCED: a verify row's valid advance is its ACCEPTED count,
+        which only the scheduler can compute from the returned matrix
+        — it calls `advance_lengths` after applying the
+        longest-accepted-prefix rule. Same failure contract as step():
+        raises before touching pool state, so retries are safe (the
+        draft proposer is deterministic in the unchanged history, so a
+        retried step rebuilds the identical row — no double
+        emission)."""
+        assert self.per_pos, "built without per_pos=True"
+        plan = _fplan.active()
+        if plan is not None:
+            err = plan.step_fault(self.n_steps)
+            if err is not None:
+                raise err
+        pool = self.pool
+        tok, _logits, pool.k, pool.v = self._fn(
+            self.engine.params,
+            jnp.asarray(tokens, jnp.int32),
+            pool.k, pool.v,
+            jnp.asarray(pool.table),
+            jnp.asarray(pool.lengths),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys, jnp.uint32),
+        )
+        self.n_steps += 1
+        return np.asarray(tok)
+
+    def advance_lengths(self, advance: np.ndarray) -> None:
+        """Fold a step_spec's per-slot length advance into the pool
+        (the emitted count per slot — n_valid for prefill rows,
+        accepted + 1 for verify rows)."""
+        self.pool.lengths = self.pool.lengths + np.asarray(advance,
+                                                           np.int32)
+
 
 class ResidentWorker:
     """Ring producer / output consumer around the device-resident
@@ -107,17 +154,20 @@ class ResidentWorker:
 
     def __init__(self, engine, pool: KVPool, chunk: int,
                  window: int = 16, ring_cap: Optional[int] = None,
-                 poll_budget: int = 8, max_stuck_windows: int = 3):
+                 poll_budget: int = 8, max_stuck_windows: int = 3,
+                 spec_k: int = 0):
         self.engine = engine
         self.pool = pool
         self.chunk = chunk
         self.window = window
         self.poll_budget = poll_budget
         self.max_stuck_windows = max_stuck_windows
+        self.spec_k = spec_k
         cap = ring_cap if ring_cap is not None else max(4 * pool.slots,
                                                         16)
         self.ring = mring.InjectionRing(cap, pool.max_pages, pool.t_max,
                                         chunk)
+        self._spec_pins: List[object] = []
         # the build contexts active NOW decide the loop's trailing
         # telemetry outputs (the trace/obs construction-time
         # discipline, ISSUE 13): a trace build adds the serve.* mark
@@ -130,7 +180,7 @@ class ResidentWorker:
         self._fn = engine.make_resident_loop(
             pool.slots, chunk, pool.page, pool.max_pages, window,
             ring_cap=cap, prompt_cap=pool.t_max,
-            poll_budget=poll_budget)
+            poll_budget=poll_budget, spec_k=spec_k)
         # newest window's telemetry (None until a window ran / when the
         # matching build was off at construction)
         self.last_window_stats = None
@@ -154,18 +204,35 @@ class ResidentWorker:
     key_for = staticmethod(sampling_key)
 
     def admit(self, slot: int, prompt, max_new: int, temperature: float,
-              seed: int, eos_id, req_id: int, at_step: int = 0) -> None:
+              seed: int, eos_id, req_id: int, at_step: int = 0,
+              prefix: int = 0) -> None:
         """Write the admission record: the slot's FULL page-table row
         (the resident mode allocates a request's whole lifetime at
         admission — the device never grows an allocation mid-loop) plus
-        the prompt the device streams prefill chunks from."""
+        the prompt the device streams prefill chunks from. `prefix` is
+        the prefix-cache hit length (serve/prefix.py): the device
+        starts prefill and the slot length there — the table row's
+        leading pages already carry that KV (KVPool.share)."""
         self.ring.admit(slot, prompt, max_new, temperature, seed,
                         eos_id, req_id,
                         self.pool.table[slot, :self.pool.max_pages],
-                        at_step=at_step)
+                        at_step=at_step, prefix=prefix)
 
     def retire(self, slot: int, req_id: int, at_step: int = 0) -> None:
         self.ring.retire(slot, req_id, at_step=at_step)
+
+    def inject_verify(self, slot: int, req_id: int, n_out: int,
+                      drafts, at_step: int = 0) -> None:
+        """Stage a KIND_VERIFY record (ISSUE 14): `drafts` proposed at
+        exactly `n_out` emitted tokens. The record's row is pinned
+        until the window that rode it returns (the device reads the
+        draft tokens from the row at its verify step)."""
+        assert self.spec_k > 0, "loop built without spec_k"
+        assert 1 <= len(drafts) <= self.spec_k, (len(drafts),
+                                                 self.spec_k)
+        self._spec_pins.append(
+            self.ring.verify(slot, req_id, n_out, drafts,
+                             at_step=at_step))
 
     def can_inject(self) -> bool:
         """Room in the ring for one more record (see
@@ -225,6 +292,13 @@ class ResidentWorker:
             jnp.asarray(self._lengths),
             pool.k, pool.v,
         )
+        # the device call returned: any verify rows staged for this
+        # window are no longer read — release their pins (a pre-launch
+        # fault above left them pinned for the retry, which relaunches
+        # with the records still pending)
+        for pin in self._spec_pins:
+            self.ring.unpin(pin)
+        self._spec_pins.clear()
         # strip the trailing telemetry outputs, stats outermost (the
         # documented strip order): primary, trace mark stream, window
         # stat rows

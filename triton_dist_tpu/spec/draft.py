@@ -1,0 +1,58 @@
+"""Draft proposers for speculative decoding (ISSUE 14).
+
+`Draft` is the proposal protocol: given a request's current token
+history, return up to k candidate continuation tokens. Proposals are
+pure HINTS — the verify step (spec/verify.py) accepts only tokens the
+target model itself emits, so a bad draft costs wasted verify columns,
+never wrong tokens.
+
+`NgramDraft` is the self-drafting baseline (prompt-lookup decoding:
+match the history's trailing n-gram against its own earlier
+occurrences and propose what followed). It needs no extra model, runs
+in microseconds on the host, and pays off exactly where production
+chat decode is most repetitive — quoting the prompt, templated
+boilerplate, greedy loops. A small draft MODEL slots into the same
+protocol later (its `propose` runs its own decode)."""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class Draft(Protocol):
+    """Proposal protocol: `propose(history, k)` returns 0..k candidate
+    next tokens for the sequence whose tokens-so-far are `history`.
+    Must be deterministic in `history` — a retried verify step
+    re-proposes and must rebuild the identical row."""
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NgramDraft:
+    """Prompt-lookup / n-gram self-drafting head.
+
+    For gram sizes n down to min_n: take the history's trailing gram,
+    find its MOST RECENT earlier occurrence, and propose the tokens
+    that followed it. Deterministic, O(len(history) * n) per proposal
+    with numpy-free host ints (histories are scheduler-side lists)."""
+
+    def __init__(self, n: int = 3, min_n: int = 1):
+        assert n >= min_n >= 1, (n, min_n)
+        self.n = n
+        self.min_n = min_n
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        hist = list(history)
+        ln = len(hist)
+        if k <= 0 or ln < self.min_n + 1:
+            return []
+        for g in range(min(self.n, ln - 1), self.min_n - 1, -1):
+            suffix = hist[ln - g:]
+            # most recent earlier occurrence of the trailing gram
+            # (i <= ln-g-1, so at least one token follows the match)
+            for i in range(ln - g - 1, -1, -1):
+                if hist[i:i + g] == suffix:
+                    return hist[i + g:i + g + k]
+        return []

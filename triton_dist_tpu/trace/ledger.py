@@ -87,6 +87,20 @@ def build_ledger(sch, tol: float = 0.05) -> dict:
             "inject_wait_us": _us(req.inject_wait_ns),
             "prefill_us": _us(phases.get("prefill", 0)),
             "decode_us": _us(phases.get("decode", 0)),
+            # spec_verify is a SUB-BUCKET of decode (ISSUE 14): the
+            # wall share of decode steps that ran a verify row. It is
+            # NOT added to the close sum — the decode phase already
+            # contains it, so the close-against-wall contract (and its
+            # tol) is untouched. 0 on unspecced runs and in resident
+            # mode (windows are step-unresolved; the counters still
+            # land in spec_steps).
+            "spec_verify_us": _us(req.spec_verify_ns),
+            "spec_steps": req.n_spec_steps,
+            # a prefix-cache hit skips [0, prefix_hit_tokens) of
+            # prefill entirely: hit requests report prefill_us ~= 0
+            # by construction (the phase only spans the residual
+            # chunks), which is the TTFT collapse the cache buys
+            "prefix_hit_tokens": req.prefix_len,
             "close_frac": (round(close, 4)
                            if close is not None else None),
             "tokens_out": len(req.out_tokens),
