@@ -916,6 +916,109 @@ def _bench_prefill_chain(mesh, eng, seq_len, k_hi=21, pairs=7,
                                 base), k_hi=k_hi, pairs=pairs)
 
 
+def _bench_plan_chain(mesh, eng, batch, seq, mode, attn_impl=None,
+                      k_hi=9, pairs=3):
+    """_bench_prefill_chain generalized to an arbitrary (batch, seq)
+    shape and an arbitrary forward `mode` string ("auto" hands routing
+    to the fusion planner; a concrete mode is the hand-routed arm the
+    planner is audited against). Same data-dependent chain discipline:
+    each iteration's first token is the previous argmax, the KV cache
+    is rebuilt from zeros inside the body."""
+    from triton_dist_tpu.models.kv_cache import KVCache
+
+    cfg = eng.cfg
+    world = mesh.devices.size
+    hkv_loc = cfg.num_kv_heads // world
+    base = jnp.zeros((batch, seq), jnp.int32)
+
+    def build(k):
+        def per_rank(params, tok, base):
+            def body(_, t):
+                toks = jnp.concatenate([t[:, None], base[:, 1:]],
+                                       axis=1)
+                cache = KVCache.create(cfg.num_layers, batch, seq,
+                                       hkv_loc, cfg.head_dim,
+                                       jnp.dtype(cfg.dtype))
+                logits, _ = forward(cfg, params, toks, cache,
+                                    mode=mode, axis="tp",
+                                    attn_impl=attn_impl)
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+
+            return jax.lax.fori_loop(0, k, body, tok)
+
+        return jax.jit(
+            jax.shard_map(
+                per_rank, mesh=mesh,
+                in_specs=(param_specs("tp"), P(None), P(None)),
+                out_specs=P(None), check_vma=False,
+            )
+        )
+
+    return _chain_timer(build, (eng.params,
+                                jnp.zeros((batch,), jnp.int32), base),
+                        k_hi=k_hi, pairs=pairs)
+
+
+def bench_plan_vs_hand(mesh, prefill_seq=64, decode_batch=4, k_hi=9,
+                       pairs=3, cfg=None, ctx=None):
+    """The fusion planner's parity + recovery family (ISSUE 17).
+
+    Two claims, three arms, two shapes:
+
+    * parity — planned (mode="auto") vs hand-routed (forcing exactly
+      the mode the planner selected for that shape) at a prefill shape
+      (B=1, S=prefill_seq) and a decode shape (B=decode_batch, S=1).
+      The planner's acceptance oracle (tests/test_plan.py) asserts the
+      two programs are bit-identical, so plan_vs_hand_* is a pure
+      dispatch-tax audit: ~1.0 means planning is free at run time (the
+      plan is priced once per (cfg, shape, world) and memoized).
+    * recovered misroute — the planner's prefill-impl routing
+      (route_prefill_impl; on a CPU rig the flash kernel's native gate
+      fails so auto routes "xla") vs FORCING the misrouted impl
+      ("pallas" runs interpret-mode here). misroute/planned >= 1.0 is
+      the regression a naively-wired model would eat and the planner
+      removes with zero layer code.
+
+    The planner's picks ride along as plan_mode_prefill /
+    plan_mode_decode string keys — the decision is part of the
+    artifact, so a silent routing flip between rounds is visible in
+    the trend. cfg/ctx/k_hi/pairs overridable for the reduced CPU rig
+    (see _main_cpu_rig); absolute *_ms arms are rig-local, only the
+    ratios are claims."""
+    from triton_dist_tpu.plan import plan_dense_forward
+
+    cfg = cfg or _rig_cfg()
+    ctx = ctx or max(prefill_seq, decode_batch)
+    eng = Engine(cfg, mesh, decode_mode="ar", max_len=ctx,
+                 fast_init=True)
+    world = mesh.devices.size
+    out = {}
+    planned_prefill_ms = None
+    for label, b, s in (("prefill", 1, prefill_seq),
+                        ("decode", decode_batch, 1)):
+        plan = plan_dense_forward(cfg, b, s, world)
+        out[f"plan_mode_{label}"] = plan.mode
+        ms, raw = _bench_plan_chain(mesh, eng, b, s, "auto",
+                                    k_hi=k_hi, pairs=pairs)
+        hand_ms, _ = _bench_plan_chain(mesh, eng, b, s, plan.mode,
+                                       k_hi=k_hi, pairs=pairs)
+        out[f"plan_{label}_ms"] = round(ms, 4)
+        out[f"plan_hand_{label}_ms"] = round(hand_ms, 4)
+        out[f"plan_vs_hand_{label}"] = round(hand_ms / max(ms, 1e-9), 4)
+        if label == "prefill":
+            planned_prefill_ms = ms
+            out["plan_raw"] = raw
+    # the misroute arm shares the prefill shape so the ratio reads the
+    # attention-impl routing alone, not a shape change
+    mis_ms, _ = _bench_plan_chain(mesh, eng, 1, prefill_seq, "auto",
+                                  attn_impl="pallas", k_hi=k_hi,
+                                  pairs=pairs)
+    out["plan_misroute_ms"] = round(mis_ms, 4)
+    out["plan_recover_misroute_ratio"] = round(
+        mis_ms / max(planned_prefill_ms, 1e-9), 4)
+    return out
+
+
 def drive_poisson(sch, prompts, arrivals, gen_len):
     """Submit `prompts` into `sch` at the given arrival offsets
     (seconds, ascending) while stepping the scheduler, until every
@@ -1484,6 +1587,10 @@ _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 _STRING_KEYS = {"metric", "unit", "ag_gemm_tuned_cfg",
                 "gemm_rs_tuned_cfg", "sp_prefill_cfg", "trace_dir",
                 "allreduce_wire_model_pick",
+                # the fusion planner's mode picks (ISSUE 17) — the
+                # decision is part of the artifact, so a routing flip
+                # between rounds shows in the trend
+                "plan_mode_prefill", "plan_mode_decode",
                 # which measurement rig produced the line ("cpu-world1"
                 # for the reduced no-TPU rig; absent on the default TPU
                 # rig) — see _main_cpu_rig and docs/performance.md
@@ -1559,6 +1666,15 @@ _NUMERIC_KEYS = {
     "serve_spec_tokens_per_s", "serve_spec_plain_tokens_per_s",
     "spec_vs_plain_tokens", "spec_accept_rate",
     "prefix_hit_ttft_us", "prefix_cold_ttft_us", "prefix_hit_ttft",
+    # fusion planner (ISSUE 17): planned (mode="auto") vs hand-routed
+    # (the planner's own pick forced) at a prefill and a decode shape
+    # — parity ratios ~1.0 (dispatch-tax audit; bit-identity is
+    # asserted in tests/test_plan.py) — plus the recovered-misroute
+    # arm: the forced-wrong prefill attention impl vs the planner's
+    # routing, ratio >= 1.0 (keys travel together + raw tails)
+    "plan_prefill_ms", "plan_hand_prefill_ms", "plan_vs_hand_prefill",
+    "plan_decode_ms", "plan_hand_decode_ms", "plan_vs_hand_decode",
+    "plan_misroute_ms", "plan_recover_misroute_ratio",
 }
 # the --faults keys travel together (an overhead claim without its trip
 # audit — or vice versa — is unfalsifiable from the artifact)
@@ -1600,7 +1716,7 @@ _AG_WIRE_KEYS = {"ag_gemm_wire_fp8_ms", "ag_gemm_wire_fp8_vs_native"}
 # noise-vs-regression question was unfalsifiable without them
 _OTHER_KEYS = {"raw", "mega_32b_raw", "prefill_raw", "prefill_s128_raw",
                "serve_levels", "sp_prefill_raw", "allreduce_wire_raw",
-               "serve_resident_raw", "serve_spec_levels"}
+               "serve_resident_raw", "serve_spec_levels", "plan_raw"}
 # the resident-serving family travels together: the ratio without both
 # absolute arms, the saturation ceiling, or the ring-pressure stats
 # would be unfalsifiable from the artifact
@@ -1623,6 +1739,15 @@ _SERVE_SPEC_KEYS = {
 # or the ratio without either — is unfalsifiable)
 _PREFIX_KEYS = {
     "prefix_hit_ttft_us", "prefix_cold_ttft_us", "prefix_hit_ttft",
+}
+# the fusion-planner family travels together: a parity ratio without
+# both absolute arms at both shapes, or the misroute ratio without its
+# absolute arm, is unfalsifiable; the planner's mode picks and the
+# prefill chain's tail stats must ride along
+_PLAN_KEYS = {
+    "plan_prefill_ms", "plan_hand_prefill_ms", "plan_vs_hand_prefill",
+    "plan_decode_ms", "plan_hand_decode_ms", "plan_vs_hand_decode",
+    "plan_misroute_ms", "plan_recover_misroute_ratio",
 }
 
 
@@ -1738,6 +1863,22 @@ def check_result(result: dict) -> list:
             problems.append(
                 f"prefix-ttft keys travel together: {k!r} missing "
                 f"while {sorted(pfx_present)[0]!r} is present")
+    pln_present = _PLAN_KEYS & set(result)
+    if pln_present:
+        for k in _PLAN_KEYS - set(result):
+            problems.append(
+                f"plan-vs-hand keys travel together: {k!r} missing "
+                f"while {sorted(pln_present)[0]!r} is present")
+        raw = result.get("plan_raw")
+        if not isinstance(raw, dict) or "diffs_ms" not in raw:
+            problems.append(
+                "plan_raw (tail-stat chain dict) must ride beside the "
+                "plan_* keys")
+        for k in ("plan_mode_prefill", "plan_mode_decode"):
+            if k not in result:
+                problems.append(
+                    f"{k!r} must ride beside the plan_* keys (the "
+                    "planner's pick is part of the artifact)")
     srv_res_present = _SERVE_RESIDENT_KEYS & set(result)
     if srv_res_present:
         for k in _SERVE_RESIDENT_KEYS - set(result):
@@ -1932,6 +2073,14 @@ def _main_cpu_rig(mesh):
             ctx=_RIG_CTX))
     except Exception as e:
         result["prefix_ttft_error"] = str(e)[:200]
+    try:
+        # fusion-planner parity + recovered-misroute family (ISSUE
+        # 17): same rig shard; the misroute arm's forced "pallas"
+        # prefill attention runs interpret-mode here, so the recovery
+        # ratio reads the routing decision the planner automates
+        result.update(bench_plan_vs_hand(mesh, cfg=cfg, ctx=_RIG_CTX))
+    except Exception as e:
+        result["plan_vs_hand_error"] = str(e)[:200]
     try:
         # iterations are sub-ms at this shape, so the chains can be
         # long: short ks flipped the slope sign run-to-run under the

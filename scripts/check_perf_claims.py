@@ -76,6 +76,10 @@ REQUIRED_CLAIMS = (
     # spec decoding + radix prefix cache (ISSUE 14)
     ("spec_vs_plain_tokens", "docs/serving.md"),
     ("prefix_hit_ttft", "docs/serving.md"),
+    # fusion planner (ISSUE 17): the parity audit and the recovered
+    # misroute are the planner's load-bearing measurements
+    ("plan_vs_hand_prefill", "docs/performance.md"),
+    ("plan_recover_misroute_ratio", "docs/performance.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still
@@ -94,6 +98,11 @@ REQUIRED_CLAIMS = (
 PENDING_FIRST_ARTIFACT = {
     "spec_vs_plain_tokens": 14,
     "prefix_hit_ttft": 14,
+    # ISSUE 17: BENCH_r08.json (cpu-world1 rig) measures the planner
+    # family; as with the spec keys the grace is normally inert — it
+    # bites only if a later round drops the arms, and dies at round 17
+    "plan_vs_hand_prefill": 17,
+    "plan_recover_misroute_ratio": 17,
 }
 
 

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Render a fusion plan (triton_dist_tpu.plan) with per-triple pricing.
+
+For each requested (model, batch, seq, world, rig, mode) this prints
+the planner's decision table: one row per matched
+producer -> collective -> consumer triple with the chosen lowering, the
+fused kernel + its shipped verify protocol, the wire format, both
+prices (fused vs sequential), and the reason the decision rests on.
+
+Exit codes (CI contract, wired into __graft_entry__'s dryrun plane and
+.github/workflows/ci.yml next to verify_kernels):
+
+  0  every fused pick is backed by a shipped @verify.protocol
+  1  an UNVERIFIABLE fusion is in the plan (a fused decision whose
+     protocol is not in the shipped registry — only a forced legacy
+     mode can produce one; auto planning falls back sequentially)
+  2  usage errors (unknown model preset / rig / mode)
+
+No jax mesh is needed: planning is pure data over the ModelConfig, so
+this runs anywhere in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the canonical shape matrix the no-args invocation audits (mirrors
+# tests/test_plan.py's golden table: prefill + decode on the headline
+# dense and MoE geometries)
+DEFAULT_MATRIX = (
+    ("qwen3_8b", 1, 512, 8, "TPU v5p", "auto"),
+    ("qwen3_8b", 16, 1, 8, "TPU v5p", "auto"),
+    ("qwen3_32b", 1, 512, 8, "TPU v5p", "auto"),
+    ("qwen3_30b_a3b", 1, 512, 8, "TPU v5p", "auto"),
+    ("qwen3_30b_a3b", 8, 1, 8, "TPU v5p", "auto"),
+)
+
+
+def _build_plan(model: str, batch: int, seq: int, world: int,
+                rig: str, mode: str):
+    from triton_dist_tpu.models import ModelConfig
+    from triton_dist_tpu.plan import plan_dense_forward
+
+    preset = getattr(ModelConfig, model, None)
+    if preset is None or not callable(preset):
+        raise KeyError(f"unknown model preset {model!r} (use a "
+                       f"ModelConfig constructor name, e.g. qwen3_8b)")
+    return plan_dense_forward(preset(), batch, seq, world, mode=mode,
+                              rig=rig)
+
+
+def unverifiable_fusions(plan) -> list:
+    """Fused decisions whose verify protocol is not shipped — the
+    exit-1 condition."""
+    from triton_dist_tpu.plan.planner import _shipped_protocols
+
+    shipped = _shipped_protocols()
+    return [d for d in plan.decisions
+            if d.fused and d.protocol not in shipped]
+
+
+def render_plan(plan, out=sys.stdout) -> None:
+    w = out.write
+    w(f"plan {plan.plan_id}  {plan.key}  rig={plan.chip}\n")
+    w(f"  requested={plan.requested!r} -> mode={plan.mode!r} "
+      f"moe_mode={plan.moe_mode!r} seq_sharded={plan.seq_sharded} "
+      f"est_layer_ms={plan.est_layer_ms:.4f}\n")
+    hdr = (f"  {'site':<12} {'pattern':<18} {'lowering':<12} "
+           f"{'kernel':<26} {'protocol':<20} {'wire':<7} "
+           f"{'fused_ms':>9} {'seq_ms':>9}\n")
+    w(hdr)
+    for d in plan.decisions:
+        mark = "*" if d.fused else " "
+        w(f" {mark}{d.site:<12} {d.pattern:<18} {d.lowered:<12} "
+          f"{d.kernel:<26} {str(d.protocol or '-'):<20} {d.wire:<7} "
+          f"{d.est_fused_ms:>9.4f} {d.est_seq_ms:>9.4f}\n")
+        if d.reason:
+            w(f"     {d.reason}\n")
+        if d.config:
+            w(f"     tile config (pricing witness): {d.config}\n")
+    w(f"  fused sites: {', '.join(plan.fused_sites()) or '(none)'}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render fusion plans with per-triple pricing")
+    ap.add_argument("--model", default=None,
+                    help="ModelConfig preset name (e.g. qwen3_8b); "
+                         "default: audit the canonical shape matrix")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--rig", default="TPU v5p")
+    ap.add_argument("--mode", default="auto")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only report unverifiable fusions")
+    args = ap.parse_args(argv)
+
+    cases = ([(args.model, args.batch, args.seq, args.world, args.rig,
+               args.mode)] if args.model else list(DEFAULT_MATRIX))
+    bad = 0
+    for model, batch, seq, world, rig, mode in cases:
+        try:
+            plan = _build_plan(model, batch, seq, world, rig, mode)
+        except (KeyError, ValueError) as e:
+            print(f"plan_report: {e}", file=sys.stderr)
+            return 2
+        if not args.quiet:
+            render_plan(plan)
+            print()
+        for d in unverifiable_fusions(plan):
+            bad += 1
+            print(f"UNVERIFIABLE FUSION: {model} b={batch} s={seq} "
+                  f"world={world}: {d.site} ({d.pattern}) lowers to "
+                  f"{d.kernel} but protocol {d.protocol!r} is not "
+                  f"shipped", file=sys.stderr)
+    if bad:
+        print(f"plan_report: {bad} unverifiable fusion(s)",
+              file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"plan_report: {len(cases)} plan(s), every fusion "
+              f"verify-backed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

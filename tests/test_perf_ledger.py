@@ -216,18 +216,20 @@ def test_check_perf_claims_repo_clean():
 
 def test_grace_ledger_retired():
     """ISSUE 12 emptied the grace ledger; ISSUE 14 re-armed it for
-    exactly the spec/prefix families under a round-14 gate — and the
-    r07 artifact already MEASURES both keys, so the grace is inert
-    (what it protects against is a later round dropping the arms).
-    Every other required claim rides no grace."""
+    exactly the spec/prefix families under a round-14 gate, and ISSUE
+    17 for the fusion-planner family under a round-17 gate — and the
+    committed artifact series already MEASURES every graced key
+    (r07 the spec/prefix pair, r08 the plan pair), so the grace is
+    inert (what it protects against is a later round dropping the
+    arms). Every other required claim rides no grace."""
     cli = _load_claims_cli()
-    assert set(cli.PENDING_FIRST_ARTIFACT) == {
-        "spec_vs_plain_tokens", "prefix_hit_ttft"}
-    assert all(rnd == 14 for rnd in cli.PENDING_FIRST_ARTIFACT.values())
+    assert cli.PENDING_FIRST_ARTIFACT == {
+        "spec_vs_plain_tokens": 14, "prefix_hit_ttft": 14,
+        "plan_vs_hand_prefill": 17, "plan_recover_misroute_ratio": 17}
     _label, measured = cli.latest_measured(REPO)
     for key in cli.PENDING_FIRST_ARTIFACT:
         assert key in measured, (
-            f"{key}: the ISSUE 14 grace must be inert — the committed "
+            f"{key}: the grace must be inert — the committed "
             "artifact series measures it")
 
 

@@ -1,0 +1,402 @@
+"""The fusion planner (ISSUE 17): one planner decides every
+collective+compute pairing.
+
+The load-bearing properties:
+
+- DECISION TABLE: the (shape, world, rig) -> pairing map is frozen as
+  goldens. A planner change that moves any routing decision must update
+  the table here — routing drift is a reviewed diff, never an accident.
+- BIT-IDENTITY: mode="auto" execution is bitwise the hand-routed path
+  it selects (the acceptance oracle); forced legacy mode strings stay
+  honored exactly.
+- FREE FUSION: a NEW naively-wired model geometry gets the fused paths
+  with zero layer code — planning is pure data over the ModelConfig.
+- LOUD FALLBACK: an unplannable site lowers sequentially with a
+  warning, and a fusion without a shipped @verify.protocol is never
+  CHOSEN (forced modes keep it, loudly).
+- ONE PLAN OBJECT: forward, Engine, and the serve Scheduler hold the
+  SAME memoized Plan for the same step shape.
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.plan import (
+    PATTERN_PROTOCOLS,
+    LayerIR,
+    OpNode,
+    Plan,
+    build_dense_ir,
+    find_triples,
+    plan_dense_forward,
+    plan_forward,
+)
+from triton_dist_tpu.plan import planner as planner_mod
+
+TP = 8
+
+
+# ---------- decision-table goldens ----------
+
+# (cfg preset, batch, seq, world, rig) -> (mode, fused sites). These are
+# GOLDENS: if a planner/pricing change moves any row, the new routing
+# must be reviewed and frozen here (the drift-on-change contract).
+DECISION_TABLE = {
+    ("qwen3_8b", 1, 512, 8, "TPU v5p"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "mlp.rs")),
+    ("qwen3_8b", 8, 1, 8, "TPU v5p"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "mlp.rs")),
+    ("qwen3_8b", 1, 2048, 8, "TPU v5p"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "mlp.rs")),
+    ("qwen3_8b", 16, 1, 8, "TPU v5p"):
+        ("ar", ("attn.rs", "mlp.rs")),
+    ("qwen3_8b", 1, 512, 4, "TPU v6e"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "mlp.rs")),
+    # MoE: the grouped-GEMM sites pair on the dense skeletons (the
+    # block's gather is named mlp.ag but feeds moe.up — the grouped
+    # ag kernel owns it)
+    ("qwen3_30b_a3b", 1, 512, 8, "TPU v5p"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "moe.rs")),
+    ("qwen3_30b_a3b", 8, 1, 8, "TPU v5p"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "moe.rs")),
+    ("tiny", 2, 8, 8, "cpu"):
+        ("dist", ("attn.ag", "attn.rs", "mlp.ag", "mlp.rs")),
+    ("tiny", 1, 64, 8, "cpu"):
+        ("ar", ("attn.rs", "mlp.rs")),
+    # tokens % world != 0: sequence-sharded lowerings are ineligible,
+    # auto must restrict to "ar"
+    ("tiny", 1, 3, 8, "cpu"):
+        ("ar", ("attn.rs", "mlp.rs")),
+}
+
+
+@pytest.mark.parametrize("case", sorted(DECISION_TABLE),
+                         ids=lambda c: f"{c[0]}-b{c[1]}s{c[2]}w{c[3]}")
+def test_decision_table_golden(case):
+    name, b, s, world, rig = case
+    cfg = getattr(ModelConfig, name)()
+    plan = plan_dense_forward(cfg, b, s, world, rig=rig)
+    want_mode, want_fused = DECISION_TABLE[case]
+    assert (plan.mode, plan.fused_sites()) == (want_mode, want_fused), (
+        f"planner routing drifted for {case}: got "
+        f"({plan.mode!r}, {plan.fused_sites()!r}) — if intentional, "
+        f"update DECISION_TABLE")
+    # every chosen fusion is backed by a shipped verify protocol
+    shipped = planner_mod._shipped_protocols()
+    for d in plan.decisions:
+        if d.fused:
+            assert d.protocol in shipped, (d.site, d.protocol)
+        assert d.est_fused_ms >= 0 and d.est_seq_ms >= 0
+
+
+def test_head_sites_never_fuse():
+    """The logits path is numerics-critical: head.ag lowers
+    sequentially (kernel-table miss by design) and head.logits is the
+    silent terminal collective (wire_eligible=False — no warning)."""
+    cfg = ModelConfig.qwen3_8b()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any plan warning is a failure
+        plan = plan_dense_forward(cfg, 1, 512, 8, rig="TPU v5p")
+    by_site = {d.site: d for d in plan.decisions}
+    assert not by_site["head.ag"].fused
+    assert not by_site["head.logits"].fused
+    assert by_site["head.logits"].wire == "native"
+
+
+def test_ar_lowering_elides_gathers():
+    cfg = ModelConfig.qwen3_8b()
+    plan = plan_dense_forward(cfg, 16, 1, 8, mode="ar", rig="TPU v5p")
+    by_site = {d.site: d for d in plan.decisions}
+    assert by_site["attn.ag"].lowered == "elided"
+    assert by_site["mlp.ag"].lowered == "elided"
+    assert by_site["attn.rs"].kernel == "gemm_ar"
+    assert by_site["attn.rs"].protocol == "allreduce"
+
+
+def test_xla_mode_is_fully_sequential():
+    cfg = ModelConfig.tiny()
+    plan = plan_dense_forward(cfg, 2, 8, TP, mode="xla", rig="cpu")
+    assert plan.seq_sharded
+    assert plan.fused_sites() == ()
+    assert all(d.kernel.startswith("lax.") for d in plan.decisions)
+
+
+# ---------- the one-Plan-object contract ----------
+
+
+def test_plan_object_is_memoized():
+    cfg = ModelConfig.tiny()
+    p1 = plan_dense_forward(cfg, 2, 8, TP, rig="cpu")
+    p2 = plan_dense_forward(cfg, 2, 8, TP, rig="cpu")
+    assert p1 is p2
+    # a different shape is a different plan
+    p3 = plan_dense_forward(cfg, 2, 16, TP, rig="cpu")
+    assert p3 is not p1 and p3.plan_id != p1.plan_id
+
+
+def test_engine_and_scheduler_share_the_plan(mesh8):
+    from triton_dist_tpu.serve import Scheduler
+
+    cfg = ModelConfig.tiny()
+    eng = Engine(cfg, mesh8, donate_cache=False, max_len=32)
+    sch = Scheduler(eng, slots=2, chunk=4, page=8)
+    assert isinstance(sch.plan, Plan)
+    assert sch.plan is eng.plan_for(2, sch.chunk, kind="decode")
+    assert sch.metrics()["plan_id"] == sch.plan.plan_id
+    # the decode plan honors the engine's forced decode mode exactly
+    assert sch.plan.requested == eng.decode_mode
+    assert sch.plan.mode == eng.decode_mode
+
+
+def test_mega_schedule_stamps_plan_id():
+    from triton_dist_tpu.mega.core import Graph
+    from triton_dist_tpu.mega.scheduler import schedule_graph
+
+    cfg = ModelConfig.tiny()
+    plan = plan_dense_forward(cfg, 2, 8, TP, rig="cpu")
+    g = Graph(batch=1)
+    x = g.buffer(128, "x", pinned=True)
+    y = g.buffer(128, "y")
+    g.add_task("op", ("op", 128), [0], reads=[x], writes=[y])
+    sched = schedule_graph(g, num_cores=1, use_native=False, plan=plan)
+    assert sched.plan_id == plan.plan_id
+    # the schedule adopted the plan's strategy
+    assert plan.mega_strategy == "least_loaded"
+
+
+# ---------- bit-identity (the acceptance oracle) ----------
+
+
+def test_auto_plan_bitwise_matches_forced_mode(mesh8):
+    """Planned execution is bit-identical to the hand-routed path it
+    selects: forward under mode='auto' must produce the SAME bits as
+    forcing the mode the planner chose."""
+    cfg = ModelConfig.tiny()
+    b, s = 2, 8
+    picked = plan_dense_forward(cfg, b, s, TP).mode
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)),
+                         jnp.int32)
+    eng_auto = Engine(cfg, mesh8, prefill_mode="auto", seed=7,
+                      donate_cache=False)
+    eng_hand = Engine(cfg, mesh8, prefill_mode=picked, seed=7,
+                      donate_cache=False)
+    la, _ = eng_auto.prefill(tokens)
+    lh, _ = eng_hand.prefill(tokens)
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lh))
+
+
+def test_forced_modes_stay_bitwise_distinct_plans(mesh8):
+    """Forcing each legacy mode string yields that mode's plan exactly
+    (the caller's contract) — and all of them produce close logits."""
+    cfg = ModelConfig.tiny()
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)),
+                         jnp.int32)
+    ref = None
+    for mode in ("dist", "xla", "ar"):
+        plan = plan_dense_forward(cfg, 2, 8, TP, mode=mode)
+        assert plan.requested == mode and plan.mode == mode
+        eng = Engine(cfg, mesh8, prefill_mode=mode, seed=3,
+                     donate_cache=False)
+        logits, _ = eng.prefill(tokens)
+        if ref is None:
+            ref = np.asarray(logits)
+        else:
+            np.testing.assert_allclose(np.asarray(logits), ref,
+                                       rtol=2e-3, atol=2e-3)
+
+
+# ---------- free fusion for a new model ----------
+
+
+def test_new_naive_model_gets_fused_paths_for_free(mesh8):
+    """A model geometry no preset ever named: the planner fuses its
+    collective+compute pairs with zero layer code (planning is pure
+    data over ModelConfig + shapes), and the model executes."""
+    cfg = ModelConfig(
+        vocab_size=32_000, hidden_size=2048, intermediate_size=5632,
+        num_layers=24, num_q_heads=16, num_kv_heads=8, head_dim=128,
+        max_positions=4096,
+    )
+    plan = plan_dense_forward(cfg, 1, 1024, 4, rig="TPU v5p")
+    assert plan.mode == "dist"
+    assert set(plan.fused_sites()) == {"attn.ag", "attn.rs",
+                                       "mlp.ag", "mlp.rs"}
+    shipped = planner_mod._shipped_protocols()
+    assert all(d.protocol in shipped
+               for d in plan.decisions if d.fused)
+    # and a never-named geometry runs end to end under mode="auto" —
+    # no per-model wiring written anywhere
+    cfg2 = ModelConfig(
+        vocab_size=512, hidden_size=96, intermediate_size=192,
+        num_layers=2, num_q_heads=8, num_kv_heads=8, head_dim=16,
+        max_positions=64, dtype="float32",
+    )
+    eng = Engine(cfg2, mesh8, prefill_mode="auto", donate_cache=False,
+                 max_len=32)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, cfg2.vocab_size, (2, 8)),
+                         jnp.int32)
+    logits, cache = eng.prefill(tokens)
+    assert np.isfinite(np.asarray(logits)).all()
+    np.testing.assert_array_equal(np.asarray(cache.length), [8, 8])
+
+
+# ---------- loud fallback + verify gating ----------
+
+
+def test_unmatched_collective_warns_and_lowers_sequentially():
+    stray = OpNode("mid.ag", "collective", axis="tp",
+                   collective="all_gather", dtype="float32",
+                   bytes=4096, wire_eligible=True)
+    ir = LayerIR(key="stray", nodes=(stray,), world=4, batch=1, seq=4)
+    with pytest.warns(UserWarning, match="unmatched collective"):
+        plan = plan_forward(ir, world=4, rig="cpu", mode="dist")
+    (d,) = plan.decisions
+    assert not d.fused and d.lowered == "sequential"
+    assert "fallback" in d.reason
+
+
+def test_unverified_fusion_never_chosen(monkeypatch):
+    """Protocol gating: with no shipped verify skeletons, auto planning
+    falls back sequential at every site — loudly."""
+    monkeypatch.setattr(planner_mod, "_shipped_protocols",
+                        lambda: frozenset())
+    cfg = ModelConfig.qwen3_8b()
+    ir = build_dense_ir(cfg, 1, 512, 8)
+    with pytest.warns(UserWarning,
+                      match="no shipped verify protocol"):
+        plan = plan_forward(ir, world=8, rig="TPU v5p", mode="auto")
+    assert plan.fused_sites() == ()
+
+
+def test_forced_mode_keeps_unverified_fusion_loudly(monkeypatch):
+    monkeypatch.setattr(planner_mod, "_shipped_protocols",
+                        lambda: frozenset())
+    cfg = ModelConfig.qwen3_8b()
+    ir = build_dense_ir(cfg, 1, 512, 8)
+    with pytest.warns(UserWarning, match="forced mode keeps"):
+        plan = plan_forward(ir, world=8, rig="TPU v5p", mode="dist")
+    assert "attn.ag" in plan.fused_sites()
+    by_site = {d.site: d for d in plan.decisions}
+    assert "not shipped" in by_site["attn.ag"].reason
+
+
+def test_fused_mode_on_dense_ir_raises():
+    cfg = ModelConfig.tiny()
+    with pytest.raises(ValueError, match="MoE one-kernel pipeline"):
+        plan_dense_forward(cfg, 2, 8, TP, mode="fused")
+
+
+def test_unknown_mode_raises():
+    cfg = ModelConfig.tiny()
+    with pytest.raises(ValueError, match="unknown mode"):
+        plan_dense_forward(cfg, 2, 8, TP, mode="turbo")
+
+
+def test_moe_fused_mode_routes_one_kernel_pipeline():
+    cfg = ModelConfig.tiny_moe()
+    plan = plan_dense_forward(cfg, 2, 8, TP, mode="fused", rig="cpu")
+    assert plan.mode == "dist" and plan.moe_mode == "fused"
+    assert plan.ffn_mode == "fused"
+    by_site = {d.site: d for d in plan.decisions}
+    assert by_site["mlp.ag"].kernel == "fused_ag_moe_up"
+    assert by_site["moe.rs"].kernel == "fused_moe_down_combine_rs"
+
+
+# ---------- IR structure ----------
+
+
+def test_ir_triples_cover_every_collective():
+    for cfg in (ModelConfig.tiny(), ModelConfig.tiny_moe()):
+        ir = build_dense_ir(cfg, 2, 8, TP)
+        colls = [i for i, nd in enumerate(ir.nodes)
+                 if nd.kind == "collective"]
+        tris = find_triples(ir)
+        assert sorted(t.collective for t in tris) == colls
+        for t in tris:
+            assert t.pattern in tuple(PATTERN_PROTOCOLS) + ("unknown",)
+
+
+def test_ir_is_hashable_and_mode_agnostic():
+    cfg = ModelConfig.tiny()
+    ir1 = build_dense_ir(cfg, 2, 8, TP)
+    ir2 = build_dense_ir(cfg, 2, 8, TP)
+    assert ir1 == ir2 and hash(ir1) == hash(ir2)
+    assert ir1.tokens == 16
+
+
+# ---------- satellite: the shared weight-stream helper ----------
+
+
+def test_weight_stream_bytes_pins_both_consumers():
+    """ONE weight-footprint definition: the serve-step roofline's
+    amortized weight stream and the mega decode ledger's weight rows
+    must reduce to the same total (the pre-refactor duplicates had to
+    agree by hand)."""
+    from triton_dist_tpu.perf_model import (
+        mega_decode_traffic_terms,
+        weight_shard_matrices,
+        weight_stream_bytes,
+    )
+
+    geom = dict(num_layers=36, hidden=4096, inter_loc=1536, hq_loc=4,
+                hkv_loc=1, head_dim=128, vocab_loc=18_992)
+    wb = weight_stream_bytes(**geom, dtype=jnp.bfloat16)
+    terms = mega_decode_traffic_terms(**geom, s_max=1024)
+    mega_wb = sum(t.nbytes for t in terms
+                  if t.name in weight_shard_matrices(1, 1, 1, 1, 1)
+                  or t.name == "lm_head")
+    assert wb == mega_wb
+    # and the closed form stays what both callers spelled by hand
+    hqd, kwd = 4 * 128, 1 * 128
+    manual = 36 * (4096 * (hqd + 2 * kwd) + hqd * 4096
+                   + 4096 * 2 * 1536 + 1536 * 4096) * 2 \
+        + 4096 * 18_992 * 2
+    assert wb == manual
+
+
+# ---------- bench schema + trend wiring ----------
+
+
+def test_bench_plan_schema_travels_together():
+    import bench
+
+    good = {
+        "metric": "x", "value": 1.0, "unit": "r", "vs_baseline": 1.0,
+        "plan_prefill_ms": 2.0, "plan_hand_prefill_ms": 2.0,
+        "plan_vs_hand_prefill": 1.0,
+        "plan_decode_ms": 1.0, "plan_hand_decode_ms": 1.0,
+        "plan_vs_hand_decode": 1.0,
+        "plan_misroute_ms": 4.0, "plan_recover_misroute_ratio": 2.0,
+        "plan_mode_prefill": "dist", "plan_mode_decode": "dist",
+        "plan_raw": {"diffs_ms": [2.0], "k": (1, 9), "p25_ms": 2.0,
+                     "min_ms": 2.0},
+    }
+    assert bench.check_result(good) == []
+    bad = dict(good)
+    del bad["plan_misroute_ms"]
+    assert any("travel together" in p for p in bench.check_result(bad))
+    bad = dict(good)
+    del bad["plan_raw"]
+    assert any("plan_raw" in p for p in bench.check_result(bad))
+    bad = dict(good)
+    del bad["plan_mode_prefill"]
+    assert any("plan_mode_prefill" in p
+               for p in bench.check_result(bad))
+
+
+def test_plan_trend_directions():
+    """The recovery ratio is a win when it grows; the parity ratios
+    pin at ~1.0 and must never flag either way."""
+    from triton_dist_tpu.obs import trend
+
+    assert trend.higher_is_better("plan_recover_misroute_ratio")
+    assert "plan_vs_hand_prefill" in trend.NEUTRAL_KEYS
+    assert "plan_vs_hand_decode" in trend.NEUTRAL_KEYS
+    assert not trend.higher_is_better("plan_misroute_ms")

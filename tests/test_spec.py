@@ -270,6 +270,59 @@ def test_choose_spec_k_monotone_in_acceptance():
     assert expected_spec_tokens(1.0, 4) == 5.0
 
 
+def test_adaptive_spec_k_decays_and_recovers(eng1):
+    """ISSUE 17 satellite: the live EWMA drives choose_spec_k — the
+    draft width decays to 0 under non-self-similar traffic (nothing
+    accepted) and recovers monotonically as acceptance returns."""
+    sch = Scheduler(eng1, spec=SpecConfig(k=K, draft=NgramDraft(),
+                                          adaptive=True), **GEO)
+    assert sch._live_spec_k() == K  # no evidence yet: configured k
+    for _ in range(20):
+        sch._note_accept_rate(0.0)
+    assert sch._spec_ewma is not None and sch._spec_ewma < 0.05
+    assert sch._live_spec_k() == 0  # spec effectively OFF
+    ks = []
+    for _ in range(40):
+        sch._note_accept_rate(1.0)
+        ks.append(sch._live_spec_k())
+    assert ks == sorted(ks), "live k must recover monotonically"
+    assert ks[-1] == K, "full acceptance restores the configured cap"
+    assert max(ks) <= K, "adaptation never exceeds the spec.k cap"
+
+
+def test_adaptive_off_keeps_configured_k(eng1):
+    """Default SpecConfig (adaptive=False) is bitwise the pre-ISSUE-17
+    behavior: observations do not fold, the live k is always spec.k."""
+    sch = Scheduler(eng1, spec=_spec(), **GEO)
+    sch._note_accept_rate(0.0)
+    assert sch._spec_ewma is None
+    assert sch._live_spec_k() == K
+
+
+def test_adaptive_spec_bitwise_and_metrics_key(eng1, prompts, baseline):
+    """Adaptation changes only what is PROPOSED: the emitted streams
+    stay bitwise the spec-off reference, and metrics carries the live
+    width under the always-present spec_k_live key."""
+    base, _ = baseline
+    sch = Scheduler(eng1, spec=SpecConfig(k=K, draft=NgramDraft(),
+                                          adaptive=True), **GEO)
+    reqs = [sch.submit(p, max_new_tokens=GEN) for p in prompts]
+    sch.run()
+    assert [r.out_tokens for r in reqs] == base
+    m = sch.metrics()
+    assert 0 <= m["spec_k_live"] <= K
+    # spec off entirely: the key is still present (= 0)
+    sch_off = Scheduler(eng1, **GEO)
+    assert sch_off.metrics()["spec_k_live"] == 0
+
+
+def test_spec_config_validates_ewma_alpha():
+    with pytest.raises(AssertionError, match="ewma_alpha"):
+        SpecConfig(k=2, ewma_alpha=0.0)
+    with pytest.raises(AssertionError, match="ewma_alpha"):
+        SpecConfig(k=2, ewma_alpha=1.5)
+
+
 def test_prune_spec_ks_keeps_off_switch():
     from triton_dist_tpu.autotuner import prune_spec_ks, spec_k_space
     from triton_dist_tpu.perf_model import CHIPS

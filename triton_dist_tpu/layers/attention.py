@@ -42,29 +42,14 @@ _BLOCKWISE_T = 4096
 
 
 def _route_prefill_impl(b, s, t, hq, hkv, d, dtype) -> str:
-    """THE prefill-impl routing predicate ("pallas" | "xla"): native
-    gate (kernels.flash_prefill.flash_prefill_native_ok — interpret
-    stays xla for CPU bit-stability) + the perf-model pick
-    (perf_model.choose_prefill_impl). Shared by gqa_attention's auto
-    path and gqa_attention_blockwise's "auto" — one place for the
-    decision, however it is reached."""
-    from triton_dist_tpu.kernels.flash_prefill import (
-        flash_prefill_fits,
-        flash_prefill_native_ok,
-    )
+    """The prefill-impl routing predicate ("pallas" | "xla"), shared by
+    gqa_attention's auto path and gqa_attention_blockwise's "auto".
+    The decision itself lives with the fusion planner
+    (plan.planner.route_prefill_impl — native gate + VMEM fit +
+    perf_model.choose_prefill_impl); this is the call-site delegate."""
+    from triton_dist_tpu.plan.planner import route_prefill_impl
 
-    if not flash_prefill_native_ok(hq, hkv, d):
-        return "xla"
-    if not flash_prefill_fits(s, t, hq, hkv, d, dtype=dtype):
-        # per-grid-step state beyond the VMEM ceiling: the blockwise
-        # xla path handles arbitrarily long context; auto must never
-        # route into a Mosaic allocation failure
-        return "xla"
-    from triton_dist_tpu.perf_model import choose_prefill_impl
-
-    return ("pallas" if choose_prefill_impl(s, t, hq, hkv, d, batch=b,
-                                            dtype=dtype) == "flash"
-            else "xla")
+    return route_prefill_impl(b, s, t, hq, hkv, d, dtype)
 
 
 def gqa_attention_blockwise(

@@ -81,10 +81,12 @@ def ep_moe_fwd(
     weights, ids = topk_routing(logits, top_k)
     if overlap:
         if n_chunks is None:
-            from triton_dist_tpu.perf_model import choose_ep_chunks
+            # the planner's EP entry (perf_model.choose_ep_chunks stays
+            # the pricing primitive behind it)
+            from triton_dist_tpu.plan.planner import plan_ep_chunks
 
             inter = params.w_down.shape[1]
-            n_chunks = choose_ep_chunks(
+            n_chunks = plan_ep_chunks(
                 m, x.shape[1], inter, e_loc, n, top_k, capacity=capacity,
                 dtype=x.dtype, payload_dtype=payload_dtype,
             )

@@ -6,6 +6,16 @@ every rank holds an expert-dim slice of EVERY expert (w_gate_up
 (E, H, 2I/n), w_down (E, I/n, H)); tokens are gathered, routed, sorted by
 expert, pushed through the grouped GEMMs, topk-combined, and
 reduce-scattered back to the sequence shards.
+
+Like tp_mlp/tp_attn, each lowering is its own function registered in
+MODES — the rewrite targets the fusion planner (triton_dist_tpu.plan)
+selects among; tp_moe_fwd is a pure dispatcher with no routing logic:
+
+  xla   — lax all_gather + reference grouped GEMM + psum_scatter
+  dist  — ag_group_gemm / moe_reduce_rs sequence-sharded fused pipeline
+  ar    — replicated tokens + grouped GEMMs + psum (decode)
+  fused — the one-kernel overlapped pair (ring AG consumed per step by
+          the grouped gate/up GEMM; capacity-padded, opt-in lossy)
 """
 
 from __future__ import annotations
@@ -42,89 +52,122 @@ class TPMoEParams(NamedTuple):
     w_down: jax.Array
 
 
+def _route(x_full, params: TPMoEParams, top_k: int):
+    """Router on the full token set, in f32. Router logits must be
+    identical on all ranks (the sort permutation must agree), so every
+    lowering computes them from the gathered/replicated tokens."""
+    logits = jnp.dot(
+        x_full.astype(jnp.float32), params.w_router.astype(jnp.float32)
+    )
+    weights, ids = topk_routing(logits, top_k)
+    return weights, ids, sort_by_expert(ids, params.w_router.shape[-1])
+
+
+def _ret(y, return_drops: bool):
+    # non-fused modes are always lossless: drops is the zero scalar
+    # (return_drops must not be silently ignored — round-5 review)
+    return (y, jnp.zeros((), jnp.int32)) if return_drops else y
+
+
+def tp_moe_ar_fwd(x_shard, params: TPMoEParams, top_k: int,
+                  axis: str = TP_AXIS, return_drops: bool = False):
+    """Replicated decode path (x_shard is (M, H) on every rank):
+    grouped GEMMs on the full token set, one psum to reduce the
+    expert-dim partial sums."""
+    weights, _, sort = _route(x_shard, params, top_k)
+    h = grouped_gemm(x_shard[sort.token_idx], params.w_gate_up,
+                     sort.group_sizes)
+    act = _silu_mul(h).astype(x_shard.dtype)
+    y_sorted = grouped_gemm(
+        act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
+    )
+    y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
+    return _ret(jax.lax.psum(y, axis), return_drops)
+
+
+def tp_moe_xla_fwd(x_shard, params: TPMoEParams, top_k: int,
+                   axis: str = TP_AXIS, return_drops: bool = False):
+    """Unfused sequence-sharded reference: lax all_gather + reference
+    grouped GEMM + psum_scatter (the parity lowering)."""
+    x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
+    weights, _, sort = _route(x_full, params, top_k)
+    h = ag_group_gemm_ref(x_shard, params.w_gate_up, sort, axis)
+    act = _silu_mul(h).astype(x_shard.dtype)
+    y_sorted = grouped_gemm(
+        act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
+    )
+    y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
+    return _ret(jax.lax.psum_scatter(y, axis, tiled=True), return_drops)
+
+
+def tp_moe_dist_fwd(x_shard, params: TPMoEParams, top_k: int,
+                    axis: str = TP_AXIS, return_drops: bool = False):
+    """Fused sequence-sharded pipeline (ref tp_moe.py:237 dist fwd):
+    the ring AG is shared between router and grouped gate/up GEMM
+    (ag_group_gemm), the combine rides the reduce-scatter
+    (moe_reduce_rs)."""
+    x_full = moe_all_gather(x_shard, axis)  # shared: router + GEMM
+    weights, _, sort = _route(x_full, params, top_k)
+    h = ag_group_gemm(x_shard, params.w_gate_up, sort, axis, x_full=x_full)
+    act = _silu_mul(h).astype(x_shard.dtype)
+    return _ret(moe_reduce_rs(
+        act, params.w_down, sort, weights, axis, out_dtype=x_shard.dtype
+    ), return_drops)
+
+
+def tp_moe_fused_fwd(x_shard, params: TPMoEParams, top_k: int,
+                     axis: str = TP_AXIS, capacity: int | None = None,
+                     capacity_factor: float | None = None,
+                     force_kernel: bool = False,
+                     return_drops: bool = False):
+    """The one-kernel overlapped pair (ring AG consumed per step by the
+    grouped gate/up GEMM with fused silu; allgather_group_gemm.
+    fused_ag_moe_up). Routing is LOCAL (replicated router weights),
+    packing is capacity-padded: `capacity` rows per (rank, expert). The
+    default is the exact M/n * top_k (zero drops — lossless like every
+    other mode); pass capacity/capacity_factor to opt into the GShard
+    drop trade, and return_drops=True to get (y, drops) with this
+    rank's dropped (token, choice) count (round-4 ADVICE: the lossy
+    mode must be detectable)."""
+    logits = jnp.dot(
+        x_shard.astype(jnp.float32),
+        params.w_router.astype(jnp.float32),
+    )
+    weights, ids = topk_routing(logits, top_k)
+    i2 = params.w_gate_up.shape[-1] // 2
+    act, meta = fused_ag_moe_up(
+        x_shard, ids, weights,
+        params.w_gate_up[..., :i2], params.w_gate_up[..., i2:],
+        axis, capacity=capacity, capacity_factor=capacity_factor,
+        force_kernel=force_kernel,
+    )
+    y = fused_moe_down_combine_rs(
+        act, params.w_down, meta, axis, out_dtype=x_shard.dtype,
+    )
+    return (y, meta.drops) if return_drops else y
+
+
+# The lowering registry — the planner's rewrite targets (tp_mlp idiom).
+MODES = {
+    "xla": tp_moe_xla_fwd,
+    "dist": tp_moe_dist_fwd,
+    "ar": tp_moe_ar_fwd,
+    "fused": tp_moe_fused_fwd,
+}
+
+
 def tp_moe_fwd(
     x_shard: jax.Array,  # (M/n, H); (M, H) replicated in 'ar' mode
     params: TPMoEParams,
     top_k: int,
     axis: str = TP_AXIS,
     mode: str = "dist",
-    capacity: int | None = None,
-    capacity_factor: float | None = None,
-    force_kernel: bool = False,
-    return_drops: bool = False,
+    **kw,
 ):
-    """TP-MoE forward (ref: tp_moe.py:237 dist fwd; :107 torch fwd for
-    mode='xla'; AR analog for the replicated decode path). Sequence-sharded
-    modes return (M/n, H); 'ar' returns (M, H) replicated.
-
-    mode='fused' runs the one-kernel overlapped pair (ring AG consumed
-    per step by the grouped gate/up GEMM with fused silu; see
-    allgather_group_gemm.fused_ag_moe_up). Routing is LOCAL (replicated
-    router weights), packing is capacity-padded: `capacity` rows per
-    (rank, expert). The default is the exact M/n * top_k (zero drops —
-    lossless like every other mode); pass capacity/capacity_factor to
-    opt into the GShard drop trade, and return_drops=True to get
-    (y, drops) with this rank's dropped (token, choice) count
-    (round-4 ADVICE: the lossy mode must be detectable)."""
-    n_experts = params.w_router.shape[-1]
-    if mode == "fused":
-        logits = jnp.dot(
-            x_shard.astype(jnp.float32),
-            params.w_router.astype(jnp.float32),
-        )
-        weights, ids = topk_routing(logits, top_k)
-        i2 = params.w_gate_up.shape[-1] // 2
-        act, meta = fused_ag_moe_up(
-            x_shard, ids, weights,
-            params.w_gate_up[..., :i2], params.w_gate_up[..., i2:],
-            axis, capacity=capacity, capacity_factor=capacity_factor,
-            force_kernel=force_kernel,
-        )
-        y = fused_moe_down_combine_rs(
-            act, params.w_down, meta, axis, out_dtype=x_shard.dtype,
-        )
-        return (y, meta.drops) if return_drops else y
-
-    def ret(y):
-        # non-fused modes are always lossless: drops is the zero scalar
-        # (return_drops must not be silently ignored — round-5 review)
-        return (y, jnp.zeros((), jnp.int32)) if return_drops else y
-    # Router on the full token set. Router logits must be identical on all
-    # ranks (the sort permutation must agree), so compute from the gathered
-    # tokens in f32.
-    if mode == "ar":
-        x_full = x_shard  # already replicated
-    elif mode == "xla":
-        x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
-    else:
-        x_full = moe_all_gather(x_shard, axis)  # shared: router + GEMM
-    logits = jnp.dot(
-        x_full.astype(jnp.float32), params.w_router.astype(jnp.float32)
-    )
-    weights, ids = topk_routing(logits, top_k)
-    sort = sort_by_expert(ids, n_experts)
-
-    if mode == "ar":
-        h = grouped_gemm(x_full[sort.token_idx], params.w_gate_up,
-                         sort.group_sizes)
-        act = _silu_mul(h).astype(x_shard.dtype)
-        y_sorted = grouped_gemm(
-            act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
-        )
-        y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
-        return ret(jax.lax.psum(y, axis))
-
-    if mode == "xla":
-        h = ag_group_gemm_ref(x_shard, params.w_gate_up, sort, axis)
-        act = _silu_mul(h).astype(x_shard.dtype)
-        y_sorted = grouped_gemm(
-            act, params.w_down, sort.group_sizes, out_dtype=jnp.float32
-        )
-        y = combine_topk(y_sorted, sort, weights).astype(x_shard.dtype)
-        return ret(jax.lax.psum_scatter(y, axis, tiled=True))
-
-    h = ag_group_gemm(x_shard, params.w_gate_up, sort, axis, x_full=x_full)
-    act = _silu_mul(h).astype(x_shard.dtype)
-    return ret(moe_reduce_rs(
-        act, params.w_down, sort, weights, axis, out_dtype=x_shard.dtype
-    ))
+    """TP-MoE forward dispatcher (ref: tp_moe.py:237 dist fwd; :107
+    torch fwd for mode='xla'; AR analog for the replicated decode
+    path). Sequence-sharded modes return (M/n, H); 'ar' returns (M, H)
+    replicated. Mode-specific knobs (the fused pipeline's capacity /
+    capacity_factor / force_kernel, every mode's return_drops) pass
+    through **kw."""
+    return MODES[mode](x_shard, params, top_k, axis=axis, **kw)

@@ -112,6 +112,10 @@ class Schedule:
     # watermarks reproduce it exactly
     stall: Any = None
     prefetch: Optional[PrefetchPlan] = None
+    # fusion-plan provenance: schedule_graph(plan=...) stamps the
+    # triton_dist_tpu.plan.Plan id so resident serving and one-shot
+    # forwards can be checked to agree on pairings
+    plan_id: Optional[str] = None
 
     @property
     def num_cores(self) -> int:
@@ -665,6 +669,7 @@ def schedule_graph(
     strategy: str = "least_loaded",
     use_native: Optional[bool] = None,
     pf_depth: Optional[int] = None,
+    plan=None,
 ) -> Schedule:
     """Schedule + plan a Graph. use_native=None auto-selects the C++ lib.
 
@@ -672,10 +677,17 @@ def schedule_graph(
     (default: byte-aware auto_pf_depth from the graph's tile rectangle;
     TDT_MEGA_PF_DEPTH pins it); the returned schedule carries
     `prefetch` (PrefetchPlan) and `stall` (predicted per-queue scoreboard
-    stall), both asserted by validate_schedule."""
+    stall), both asserted by validate_schedule.
+
+    plan (optional triton_dist_tpu.plan.Plan): the fusion plan this
+    graph was lowered under — the schedule adopts its mega_strategy and
+    carries its plan_id, so the megakernel and the layer-forward planes
+    provably run the SAME pairing decisions."""
     n = len(graph.tasks)
     if n == 0:
         raise ValueError("empty megakernel graph")
+    if plan is not None:
+        strategy = plan.mega_strategy
     if pf_depth is None:
         # byte-aware default: size the rotating arena from this graph's
         # actual tile rectangle (auto_pf_depth; TDT_MEGA_PF_DEPTH wins)
@@ -690,6 +702,8 @@ def schedule_graph(
     def _finalize(sched: Schedule) -> Schedule:
         sched.stall = predicted_stalls(graph, sched)
         sched.prefetch = plan_prefetch(graph, sched, depth=pf_depth)
+        if plan is not None:
+            sched.plan_id = plan.plan_id
         return sched
 
     if lib is not None:

@@ -5,12 +5,17 @@ TPU-native re-design of the reference's DenseLLM/DenseLLMLayer
 with a per-layer fwd mode switch (:84-98) becomes a functional model —
 params are pytrees of per-rank shards (leading mesh-axis dim, consumed by
 shard_map in_specs), the layer stack is a `lax.scan` over stacked layer
-params (one trace for all layers), and the three forward modes mirror the
+params (one trace for all layers), and the forward modes mirror the
 reference's torch / triton_dist / triton_dist_AR:
 
   xla  — unfused collectives (parity reference)
   dist — ag_gemm/gemm_rs sequence-sharded pipeline (prefill)
   ar   — replicated activations + gemm_ar (decode / low latency)
+
+Mode routing is OWNED by the fusion planner (triton_dist_tpu.plan):
+`forward` resolves its `mode` argument to a Plan and executes through
+plan/execute — this module contains no fused-vs-sequential branches.
+mode="auto" lets the planner price the lowerings per shape.
 
 Sharding layout per tensor (n = tp size):
   embed (V, H) replicated · norms (L, H) replicated
@@ -34,11 +39,11 @@ from triton_dist_tpu.layers import (
     TPMLPParams,
     rms_norm,
     rope_table,
-    tp_attn_fwd,
-    tp_mlp_fwd,
 )
 from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.plan import execute as plan_exec
+from triton_dist_tpu.plan.planner import Plan, plan_dense_forward
 from triton_dist_tpu.runtime.init import TP_AXIS
 
 
@@ -172,34 +177,31 @@ def init_params(
 
 
 def _layer_fwd(cfg: ModelConfig, spec: TPAttnSpec, cos, sin, positions,
-               kv_len, batch, axis, mode, attn_impl, x,
+               kv_len, batch, axis, plan: Plan, x,
                lp: DenseLayerParams, kv):
-    """One transformer block (ref DenseLLMLayer.fwd, dense.py:101-114)."""
+    """One transformer block (ref DenseLLMLayer.fwd, dense.py:101-114).
+    All mode/impl routing lives in the Plan (triton_dist_tpu.plan):
+    this function only states the block structure."""
     attn_params = TPAttnParams(
         w_qkv=lp.w_qkv, w_o=lp.w_o,
         q_norm=lp.q_norm if cfg.use_qk_norm else None,
         k_norm=lp.k_norm if cfg.use_qk_norm else None,
     )
     h = rms_norm(x, lp.input_ln, cfg.rms_eps)
-    attn_out, kv = tp_attn_fwd(
-        h, attn_params, spec, cos, sin, positions, batch,
-        axis=axis, mode=mode, kv_cache=kv, kv_len=kv_len,
-        attn_impl=attn_impl,
+    attn_out, kv = plan_exec.attn_fwd(
+        plan, h, attn_params, spec, cos, sin, positions, batch,
+        axis, kv, kv_len,
     )
     x = x + attn_out
     h = rms_norm(x, lp.post_attn_ln, cfg.rms_eps)
     if cfg.is_moe:
-        from triton_dist_tpu.layers import TPMoEParams, tp_moe_fwd
+        from triton_dist_tpu.layers import TPMoEParams
 
-        mlp_out = tp_moe_fwd(
-            h, TPMoEParams(lp.w_router, lp.w_gate_up, lp.w_down),
-            cfg.num_experts_per_tok, axis=axis, mode=mode,
-        )
+        ffn_params = TPMoEParams(lp.w_router, lp.w_gate_up, lp.w_down)
     else:
-        mlp_out = tp_mlp_fwd(
-            h, TPMLPParams(lp.w_gate, lp.w_up, lp.w_down),
-            axis=axis, mode=mode,
-        )
+        ffn_params = TPMLPParams(lp.w_gate, lp.w_up, lp.w_down)
+    mlp_out = plan_exec.ffn_fwd(plan, h, ffn_params, axis,
+                                top_k=cfg.num_experts_per_tok)
     x = x + mlp_out
     return x, kv
 
@@ -213,19 +215,34 @@ def forward(
     axis: str = TP_AXIS,
     return_full_logits: bool = False,
     attn_impl: Optional[str] = None,
+    plan: Optional[Plan] = None,
 ):
     """Per-device forward (inside shard_map). Returns (logits, new_cache);
     logits (B, V) for the last position (or (B, S, V) if
     return_full_logits). attn_impl: prefill attention implementation
     override ("xla" | "pallas"; None = auto — the flash-prefill switch,
-    layers/attention.py). Mirrors the reference inference entry
-    (ref: models/dense.py:221-241 `inference`)."""
+    plan.route_prefill_impl). Mirrors the reference inference entry
+    (ref: models/dense.py:221-241 `inference`).
+
+    Routing is the fusion planner's (triton_dist_tpu.plan): a legacy
+    `mode` string is honored bit-for-bit as a plan constraint,
+    mode="auto" lets the planner price the lowerings, and a prebuilt
+    `plan` (the same memoized object Engine / serve / mega hold)
+    short-circuits planning entirely."""
     if cache is None:
         raise ValueError("forward requires a KVCache (create one per serve)")
     n = jax.lax.axis_size(axis)
     b, s = tokens.shape
     h_dim = cfg.hidden_size
     m = b * s
+    if plan is None:
+        # trace-time planning on static shapes: memoized, so this is a
+        # dict lookup on every retrace of the same step geometry
+        plan = plan_dense_forward(cfg, b, s, n, mode=mode,
+                                  attn_impl=attn_impl)
+    from triton_dist_tpu.trace import events as _tev
+
+    _tev.note_plan(plan.plan_id)  # trace provenance (Timeline.plan_id)
     spec = TPAttnSpec(cfg.num_q_heads // n, cfg.num_kv_heads // n,
                       cfg.head_dim)
     cos, sin = rope_table(cfg.head_dim, cfg.max_positions, cfg.rope_theta)
@@ -235,19 +252,12 @@ def forward(
     kv_len = start + s
 
     x = params.embed[tokens].reshape(m, h_dim)
-    # `layers` modes get sequence-sharded residuals; ar/xla-decode keeps
-    # them replicated. The xla mode is also sequence-sharded (parity with
-    # dist).
-    seq_sharded = mode in ("dist", "xla")
-    if seq_sharded:
-        assert m % n == 0, f"B*S={m} must divide tp={n} in {mode} mode"
-        me = jax.lax.axis_index(axis)
-        x = jax.lax.dynamic_slice_in_dim(x, me * (m // n), m // n)
+    x = plan_exec.shard_tokens(x, axis, plan)
 
     def step(x, xs):
         lp, k_l, v_l = xs
         x, kv = _layer_fwd(cfg, spec, cos, sin, positions, kv_len, b,
-                           axis, mode, attn_impl, x, lp, (k_l, v_l))
+                           axis, plan, x, lp, (k_l, v_l))
         return x, kv
 
     # strip the n-axis dim (shard_map gives size-1 shards on that dim)
@@ -260,8 +270,7 @@ def forward(
     )
     new_cache = KVCache(k=k_new, v=v_new, length=kv_len)
 
-    if seq_sharded:
-        x = jax.lax.all_gather(x, axis, tiled=True)  # (M, H)
+    x = plan_exec.gather_tokens(x, axis, plan)  # (M, H) when sharded
     x = rms_norm(x, params.final_ln, cfg.rms_eps)
     x = x.reshape(b, s, h_dim)
     if not return_full_logits:

@@ -46,7 +46,8 @@ def sample_token(logits, key=None, temperature: float = 0.0):
 
 def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
                      params, tokens, pool_k, pool_v, table, lengths,
-                     n_valid, temps, keys, per_pos: bool = False):
+                     n_valid, temps, keys, per_pos: bool = False,
+                     plan=None):
     """THE per-rank serve-step computation (inside shard_map): one
     fixed-geometry (slots, chunk) forward over the paged pool's dense
     view, per-slot sampling, and the null-page-routed KV scatter.
@@ -69,7 +70,7 @@ def _serve_step_math(cfg, mode, axis, slots, chunk, page, t_pool,
     cache = KVCache.dense_view(pool_k, pool_v, table, lengths)
     logits, new_cache = forward(
         cfg, params, tokens, cache, mode=mode, axis=axis,
-        return_full_logits=True,
+        return_full_logits=True, plan=plan,
     )  # logits (K, C, V) f32, new_cache k/v (L, K, T, Hkv, D)
     bidx = jnp.arange(slots)[:, None]
     last = logits[jnp.arange(slots),
@@ -187,6 +188,18 @@ class Engine:
         # _gen_cache, and shared between Engine.serve's stepwise path
         # and the serve-plane Worker so both replay ONE executable.
         self._serve_cache: dict = {}
+
+    def plan_for(self, batch: int, seq: int, kind: str = "decode"):
+        """The fusion plan (triton_dist_tpu.plan.Plan) this engine's
+        forwards execute under at the given step geometry. Memoized in
+        the planner, so this IS the same object `forward` resolves
+        inside the compiled step — the serve Scheduler and
+        mega.schedule_graph consume it to provably agree on pairings."""
+        from triton_dist_tpu.plan.planner import plan_dense_forward
+
+        mode = self.prefill_mode if kind == "prefill" else self.decode_mode
+        n = int(self.mesh.shape[self.axis])
+        return plan_dense_forward(self.cfg, batch, seq, n, mode=mode)
 
     def _gen_fn(self, steps: int, greedy: bool):
         key = (steps, greedy)
@@ -314,13 +327,16 @@ class Engine:
         axis = self.axis
         t_pool = max_pages * page
         self._check_serve_geometry(slots, chunk, page, max_pages)
+        # the ONE Plan for this step geometry (same memoized object the
+        # serve Scheduler and mega builders hold — plan_for doc)
+        plan = self.plan_for(slots, chunk, kind="decode")
 
         def per_rank(params, tokens, pool_k, pool_v, table, lengths,
                      n_valid, temps, keys):
             return _serve_step_math(
                 cfg, mode, axis, slots, chunk, page, t_pool,
                 params, tokens, pool_k, pool_v, table, lengths,
-                n_valid, temps, keys, per_pos=per_pos)
+                n_valid, temps, keys, per_pos=per_pos, plan=plan)
 
         pool_spec = P(None, self.axis)
         return jax.jit(
@@ -342,7 +358,9 @@ class Engine:
             f"{self.cfg.max_positions} (rope table)"
         )
         n = int(self.mesh.shape[self.axis])
-        if self.decode_mode in ("dist", "xla"):
+        from triton_dist_tpu.plan.planner import SEQ_SHARDED_MODES
+
+        if self.decode_mode in SEQ_SHARDED_MODES:
             assert (slots * chunk) % n == 0, (
                 f"sequence-sharded mode {self.decode_mode!r} needs "
                 f"slots*chunk ({slots}*{chunk}) divisible by tp={n}"
@@ -445,6 +463,9 @@ class Engine:
         axis = self.axis
         t_pool = max_pages * page
         self._check_serve_geometry(slots, chunk, page, max_pages)
+        # same memoized Plan object as make_serve_step's — the resident
+        # loop and the host-loop replay agree on pairings by identity
+        plan = self.plan_for(slots, chunk, kind="decode")
         assert window >= 1 and ring_cap >= 2 and poll_budget >= 1
         tb_build = _tev.active_build()
         ob_build = _ost.active_build()
@@ -555,7 +576,7 @@ class Engine:
                     tok_all, _last, pk, pv = _serve_step_math(
                         cfg, mode, axis, slots, chunk, page, t_pool,
                         params, tokens, pk, pv, tb, ln,
-                        n_valid, temps, keys, per_pos=True)
+                        n_valid, temps, keys, per_pos=True, plan=plan)
                     prefill = ss[:, mring.SS_PHASE] == 0
                     base = jnp.maximum(n_valid - 1 - kdv, 0)
                     span = jnp.arange(spec_k + 1, dtype=jnp.int32)
@@ -652,7 +673,7 @@ class Engine:
                     tok, _last, pk, pv = _serve_step_math(
                         cfg, mode, axis, slots, chunk, page, t_pool,
                         params, tokens, pk, pv, tb, ln,
-                        n_valid, temps, keys)
+                        n_valid, temps, keys, plan=plan)
                     ln = ln + n_valid
                     # post-step slot-state advance (mirrors the host
                     # scheduler's per-plan bookkeeping field for field)

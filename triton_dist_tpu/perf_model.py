@@ -561,6 +561,39 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def weight_shard_matrices(hidden: int, inter_loc: int, hq_loc: int,
+                          hkv_loc: int, head_dim: int) -> dict:
+    """The per-rank, per-layer dense weight matrices as wname -> (K, N),
+    mirroring mega/qwen3.build_qwen3_graph's branch keys. The ONE
+    definition of the layer's weight footprint: the megakernel decode
+    ledger turns these into TrafficTerm rows and the serve-step
+    roofline sums them into its amortized-once weight stream — the two
+    callers previously spelled the same four shapes independently."""
+    hqd = hq_loc * head_dim
+    kwd = hkv_loc * head_dim
+    return {
+        "w_qkv": (hidden, hqd + 2 * kwd),
+        "w_o": (hqd, hidden),
+        "w_gate_up": (hidden, 2 * inter_loc),
+        "w_down": (inter_loc, hidden),
+    }
+
+
+def weight_stream_bytes(num_layers: int, hidden: int, inter_loc: int,
+                        hq_loc: int, hkv_loc: int, head_dim: int,
+                        vocab_loc: int, dtype=jnp.bfloat16) -> int:
+    """Bytes of ONE full pass over the per-rank weight shard: L x the
+    weight_shard_matrices footprint plus the lm_head. This is the
+    paid-once-per-step term continuous batching amortizes — both
+    estimate_serve_step_ms and the mega decode ledger's weight rows
+    reduce to exactly this total (tests/test_plan.py pins the
+    equality)."""
+    isz = _dtype_bytes(dtype)
+    per_layer = sum(k * n for k, n in weight_shard_matrices(
+        hidden, inter_loc, hq_loc, hkv_loc, head_dim).values())
+    return (num_layers * per_layer + hidden * vocab_loc) * isz
+
+
 def mega_decode_traffic_terms(
     num_layers: int,
     hidden: int,
@@ -599,12 +632,10 @@ def mega_decode_traffic_terms(
     hqdp = _round_up(hqd, 128)
     kwp = _round_up(kw, 128)
 
-    mm = {  # wname -> (K, N), mirroring build_qwen3_graph's branch keys
-        "w_qkv": (hidden, wqkv),
-        "w_o": (hqd, hidden),
-        "w_gate_up": (hidden, 2 * inter_loc),
-        "w_down": (inter_loc, hidden),
-    }
+    # wname -> (K, N): the ONE weight-footprint definition shared with
+    # estimate_serve_step_ms (weight_stream_bytes pins the totals equal)
+    mm = weight_shard_matrices(hidden, inter_loc, hq_loc, hkv_loc,
+                               head_dim)
     tn_of = plan_mm_tiles([("matmul", w, k, n, None, 0.0)
                            for w, (k, n) in mm.items()])
     terms = []
@@ -842,12 +873,11 @@ def estimate_serve_step_ms(
     chip = chip or detect_chip()
     b = _dtype_bytes(dtype)
     hqd, kwd = hq_loc * head_dim, hkv_loc * head_dim
-    w_bytes = num_layers * (
-        hidden * (hqd + 2 * kwd)      # qkv
-        + hqd * hidden                # o
-        + hidden * 2 * inter_loc      # gate|up
-        + inter_loc * hidden          # down
-    ) * b + hidden * vocab_loc * b    # lm_head
+    # the paid-once weight stream: the shared shard-footprint helper
+    # (same matrices the mega decode ledger prices, lm_head included)
+    w_bytes = weight_stream_bytes(num_layers, hidden, inter_loc,
+                                  hq_loc, hkv_loc, head_dim, vocab_loc,
+                                  dtype=dtype)
     kv_bytes = 2 * num_layers * kwd * kv_tokens * b
     act_bytes = n_tokens * num_layers * (4 * hidden + 3 * inter_loc) * b
     if attn_impl == "xla":

@@ -1,0 +1,76 @@
+"""Execute a Plan: route the model forward through the planner's
+decisions (plan/__init__ doc).
+
+The layer MODES registries (tp_attn / tp_mlp / tp_moe) are the rewrite
+targets: a TripleDecision never constructs a kernel call itself, it
+picks WHICH registered lowering the layer runs, so every fused path the
+plan can select is exactly a hand path the tier-1 suite already pins —
+that is the bit-identity oracle. `models/dense.py` calls these four
+helpers and carries no fused-vs-sequential routing of its own:
+
+  shard_tokens / gather_tokens   the sequence-sharding boundary
+      (Plan.seq_sharded — was dense.py's inline
+      `mode in ("dist", "xla")` predicate)
+  attn_fwd / ffn_fwd             the per-block dispatch through the
+      layer registries under Plan.mode / Plan.ffn_mode
+
+Unknown triples never reach here: the planner already lowered them
+sequentially (loudly), so execution only ever sees mode strings the
+layer registries define — an unplanned mode string is a KeyError at
+trace time, not a silent wrong kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from triton_dist_tpu.plan.planner import Plan
+
+
+def shard_tokens(x: jax.Array, axis: str, plan: Plan) -> jax.Array:
+    """Slice this rank's token rows on forward entry when the plan's
+    lowering is sequence-sharded; replicated lowerings pass through."""
+    if not plan.seq_sharded:
+        return x
+    n = jax.lax.axis_size(axis)
+    m = x.shape[0]
+    assert m % n == 0, (
+        f"B*S={m} must divide tp={n} in {plan.mode} mode")
+    me = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_slice_in_dim(x, me * (m // n), m // n)
+
+
+def gather_tokens(x: jax.Array, axis: str, plan: Plan) -> jax.Array:
+    """Regather the full token set before the head (the seq-sharded
+    lowerings' closing collective; replicated lowerings pass through)."""
+    if not plan.seq_sharded:
+        return x
+    return jax.lax.all_gather(x, axis, tiled=True)
+
+
+def attn_fwd(plan: Plan, h, attn_params, spec, cos, sin, positions,
+             batch, axis, kv_cache, kv_len):
+    """The attention block under the plan: tp_attn's MODES registry
+    keyed by Plan.mode, prefill impl per Plan.attn_impl (None = the
+    planner's per-shape route_prefill_impl at the call site)."""
+    from triton_dist_tpu.layers import tp_attn_fwd
+
+    return tp_attn_fwd(
+        h, attn_params, spec, cos, sin, positions, batch,
+        axis=axis, mode=plan.mode, kv_cache=kv_cache, kv_len=kv_len,
+        attn_impl=plan.attn_impl,
+    )
+
+
+def ffn_fwd(plan: Plan, h, params, axis, top_k=None):
+    """The FFN block under the plan: tp_moe's registry keyed by
+    Plan.moe_mode for MoE configs (which is where the planner may pick
+    the one-kernel fused pipeline), tp_mlp's keyed by Plan.mode."""
+    if plan.is_moe:
+        from triton_dist_tpu.layers import tp_moe_fwd
+
+        return tp_moe_fwd(h, params, top_k, axis=axis,
+                          mode=plan.moe_mode)
+    from triton_dist_tpu.layers import tp_mlp_fwd
+
+    return tp_mlp_fwd(h, params, axis=axis, mode=plan.mode)

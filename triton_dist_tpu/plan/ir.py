@@ -1,0 +1,236 @@
+"""The layer-IR the fusion planner pattern-matches (plan/__init__ doc).
+
+One transformer block + the LM head, as a flat tuple of `OpNode`s in
+execution order. The IR is MODE-AGNOSTIC: it records the logical
+computation under its TP sharding (which collectives the sharding
+implies, what each GEMM's local shape is), and the planner prices the
+candidate LOWERINGS of that one IR — sequence-sharded fused ("dist"),
+sequence-sharded unfused ("xla"), replicated ("ar"), and the MoE
+one-kernel pipeline ("fused") — rather than holding one IR per mode.
+
+Everything here is hashable pure-python data (frozen dataclasses of
+ints/strings), so plans memoize on the IR key and building the IR at
+trace time costs microseconds, never a recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class OpNode:
+    """One op of the layer computation.
+
+    kind      "gemm" | "grouped_gemm" | "attention" | "norm" | "router"
+              | "collective"
+    m, k, n   GEMM row/contraction/column dims as the op sees them
+              locally (m is the GLOBAL row count reaching the op; the
+              lowering decides how rows shard). 0 for non-GEMM ops.
+    collective  kind=="collective": "all_gather" | "reduce_scatter" |
+              "all_reduce" | "all_to_all".
+    bytes     collective payload in NATIVE bytes, per the perf_model
+              convention (per-rank shard for the gather family, full
+              per-device tensor for the reduction family).
+    wire_eligible  whether the collective may ride a quantized wire
+              (choose_wire_format prices it; numerics-critical legs —
+              the logits gather — stay native).
+    meta      sorted (key, value) extras (attention geometry, epilogue
+              tags) — a tuple so the node stays hashable.
+    """
+
+    name: str
+    kind: str
+    m: int = 0
+    k: int = 0
+    n: int = 0
+    dtype: str = "bfloat16"
+    axis: Optional[str] = None
+    collective: Optional[str] = None
+    bytes: int = 0
+    wire_eligible: bool = False
+    meta: Tuple[Tuple[str, int], ...] = ()
+
+    def get(self, key: str, default: int = 0) -> int:
+        for k, v in self.meta:
+            if k == key:
+                return v
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class Triple:
+    """A matched producer -> collective -> consumer site (indices into
+    LayerIR.nodes; producer/consumer may be -1 when the collective has
+    no compute op on that side, e.g. the logits gather)."""
+
+    producer: int
+    collective: int
+    consumer: int
+    pattern: str
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerIR:
+    """The planner's unit of work: `nodes` in execution order, plus the
+    geometry the builders baked the shapes from."""
+
+    key: str
+    nodes: Tuple[OpNode, ...]
+    world: int
+    batch: int
+    seq: int
+    is_moe: bool = False
+
+    @property
+    def tokens(self) -> int:
+        return self.batch * self.seq
+
+
+_COMPUTE_KINDS = ("gemm", "grouped_gemm", "attention")
+
+# collective -> which side the fusable compute op sits on
+_CONSUMER_COLLECTIVES = ("all_gather",)
+_PRODUCER_COLLECTIVES = ("reduce_scatter", "all_reduce")
+
+
+def find_triples(ir: LayerIR) -> Tuple[Triple, ...]:
+    """Pattern-match every producer -> collective -> consumer site.
+
+    A gather feeds the NEXT compute op (AG+GEMM class); a reduction is
+    fed by the PREVIOUS one (GEMM+RS / GEMM+AR class). Norms and
+    routers are transparent: the reference fuses across them exactly
+    like this repo's hand wiring does (the router consumes the same
+    gathered activations the grouped GEMM does). An unmatched
+    collective still yields a Triple (producer/consumer -1, pattern
+    "unknown") so the planner can fall back LOUDLY instead of silently
+    skipping a site."""
+    nodes = ir.nodes
+    out = []
+    for i, node in enumerate(nodes):
+        if node.kind != "collective":
+            continue
+        if node.collective in _CONSUMER_COLLECTIVES:
+            cons = next((j for j in range(i + 1, len(nodes))
+                         if nodes[j].kind in _COMPUTE_KINDS), -1)
+            if cons >= 0:
+                out.append(Triple(-1, i, cons,
+                                  f"ag+{nodes[cons].kind}"))
+            else:
+                out.append(Triple(-1, i, -1, "unknown"))
+        elif node.collective in _PRODUCER_COLLECTIVES:
+            prod = next((j for j in range(i - 1, -1, -1)
+                         if nodes[j].kind in _COMPUTE_KINDS), -1)
+            tag = "rs" if node.collective == "reduce_scatter" else "ar"
+            if prod >= 0:
+                out.append(Triple(prod, i, -1,
+                                  f"{nodes[prod].kind}+{tag}"))
+            else:
+                out.append(Triple(-1, i, -1, "unknown"))
+        else:
+            # all_to_all (the EP plane) and anything future: matched by
+            # the adjacent grouped GEMM when present
+            cons = i + 1 if (i + 1 < len(nodes)
+                             and nodes[i + 1].kind == "grouped_gemm") \
+                else -1
+            out.append(Triple(-1, i, cons,
+                              "a2a+grouped_gemm" if cons >= 0
+                              else "unknown"))
+    return tuple(out)
+
+
+def _dtype_bytes(dtype: str) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    return int(np.dtype(jnp.dtype(dtype)).itemsize)
+
+
+def build_dense_ir(cfg, batch: int, seq: int, world: int,
+                   kv_len: Optional[int] = None) -> LayerIR:
+    """Emit the layer-IR of one `models/dense.py` transformer block plus
+    the LM head, from the ModelConfig and the step geometry. The MoE
+    block swaps the dense MLP for router + grouped GEMMs — the same
+    structural walk `models/dense._layer_fwd` executes, as data."""
+    n = max(world, 1)
+    h, d = cfg.hidden_size, cfg.head_dim
+    hq_l, hkv_l = cfg.num_q_heads // n, cfg.num_kv_heads // n
+    wqkv_l = (hq_l + 2 * hkv_l) * d
+    hqd_l = hq_l * d
+    v_l = cfg.vocab_size // n
+    m = batch * seq
+    isz = _dtype_bytes(cfg.dtype)
+    t = kv_len if kv_len is not None else seq
+    ax = "tp"
+
+    def ag(name, rows):
+        # gather family: per-rank shard bytes (perf_model convention)
+        return OpNode(name, "collective", axis=ax,
+                      collective="all_gather", dtype=cfg.dtype,
+                      bytes=max(rows // n, 1) * h * isz,
+                      wire_eligible=True)
+
+    def rs(name, rows):
+        # reduction family: full per-device tensor bytes
+        return OpNode(name, "collective", axis=ax,
+                      collective="reduce_scatter", dtype=cfg.dtype,
+                      bytes=rows * h * isz, wire_eligible=True)
+
+    nodes = [
+        OpNode("attn.ln", "norm", dtype=cfg.dtype),
+        ag("attn.ag", m),
+        OpNode("attn.qkv", "gemm", m=m, k=h, n=wqkv_l, dtype=cfg.dtype,
+               axis=ax),
+        OpNode("attn.core", "attention", dtype=cfg.dtype,
+               meta=(("batch", batch), ("d", d), ("hkv", hkv_l),
+                     ("hq", hq_l), ("s_q", seq), ("t", t))),
+        OpNode("attn.o", "gemm", m=m, k=hqd_l, n=h, dtype=cfg.dtype,
+               axis=ax),
+        rs("attn.rs", m),
+        OpNode("mlp.ln", "norm", dtype=cfg.dtype),
+        ag("mlp.ag", m),
+    ]
+    if cfg.is_moe:
+        mi_l = cfg.moe_intermediate_size // n
+        e = cfg.num_experts
+        top_k = cfg.num_experts_per_tok
+        rows = m * top_k
+        nodes += [
+            OpNode("moe.router", "router", m=m, k=h, n=e,
+                   dtype=cfg.dtype),
+            OpNode("moe.up", "grouped_gemm", m=rows, k=h, n=2 * mi_l,
+                   dtype=cfg.dtype, axis=ax,
+                   meta=(("experts", e), ("top_k", top_k))),
+            OpNode("moe.down", "grouped_gemm", m=rows, k=mi_l, n=h,
+                   dtype=cfg.dtype, axis=ax,
+                   meta=(("experts", e), ("top_k", top_k))),
+            rs("moe.rs", m),
+        ]
+    else:
+        i_l = cfg.intermediate_size // n
+        nodes += [
+            OpNode("mlp.gate_up", "gemm", m=m, k=h, n=2 * i_l,
+                   dtype=cfg.dtype, axis=ax,
+                   meta=(("epilogue_silu_pair", 1),)),
+            OpNode("mlp.down", "gemm", m=m, k=i_l, n=h, dtype=cfg.dtype,
+                   axis=ax),
+            rs("mlp.rs", m),
+        ]
+    nodes += [
+        OpNode("final.ln", "norm", dtype=cfg.dtype),
+        # residual stream regathered for the head (seq-sharded lowering)
+        ag("head.ag", m),
+        OpNode("head.lm", "gemm", m=batch, k=h, n=v_l, dtype=cfg.dtype,
+               axis=ax),
+        # the logits gather is numerics-critical (sampling reads it
+        # bitwise) — never wire-quantized
+        OpNode("head.logits", "collective", axis=ax,
+               collective="all_gather", dtype="float32",
+               bytes=batch * v_l * 4, wire_eligible=False),
+    ]
+    kind = "moe" if cfg.is_moe else "dense"
+    key = (f"{kind}(L={cfg.num_layers},h={h},b={batch},s={seq},"
+           f"world={n})")
+    return LayerIR(key=key, nodes=tuple(nodes), world=n, batch=batch,
+                   seq=seq, is_moe=cfg.is_moe)

@@ -1,0 +1,527 @@
+"""The fusion planner: price the candidate lowerings of one LayerIR and
+emit a `Plan` (plan/__init__ doc; ROADMAP item 5).
+
+The planner owns COMPOSITION, not pricing: every number it compares
+comes from the existing `perf_model` estimators (estimate_ag_gemm_ms,
+estimate_ag_ms/rs/ar, estimate_gemm_ms, choose_wire_format,
+choose_prefill_impl, choose_ep_chunks) and the `autotuner` pruners —
+those stay the single sources of truth. What used to be scattered as
+hand `mode=` wiring in `models/dense.py` and `layers/tp_moe.py` is here
+one decision per matched producer -> collective -> consumer triple:
+
+  lowering   "dist" fuses AG+GEMM / GEMM+RS, "xla" runs the sequential
+             lax reference, "ar" elides the gather (replicated
+             activations) and fuses the reduction as GEMM+AR, and the
+             MoE "fused" pipeline runs the one-kernel grouped path.
+  verify     a fusion is only CHOSEN when its transport skeleton has a
+             shipped `@verify.protocol` model (PATTERN_PROTOCOLS);
+             otherwise the triple falls back to sequential with a
+             warnings.warn the tests pin. A forced legacy mode string
+             is the caller's contract and is honored bit-for-bit.
+  wire       per-collective via choose_wire_format under the plan's
+             error budget (the default budget 0.0 forces native wire,
+             which is what keeps planned execution bit-identical to the
+             hand path).
+  configs    the autotuner's top-1 pruned tile config is recorded per
+             fused triple as the pricing witness; execution keeps the
+             kernels' own defaults so the bit-identity oracle holds
+             (threading plan configs into the kernels is the recorded
+             follow-up in ROADMAP).
+
+`plan_dense_forward` memoizes on the hashable (cfg, geometry, mode)
+tuple, so the model forward, `models/engine.Engine`, the serve
+`Scheduler`, and `mega.schedule_graph` all hold the SAME Plan object
+for the same step shape — resident serving and one-shot forwards agree
+on pairings by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import warnings
+from typing import Optional, Tuple
+
+from triton_dist_tpu.plan.ir import LayerIR, build_dense_ir, find_triples
+
+# The two sequence-sharded lowerings: forward slices tokens by rank on
+# entry and regathers before the head. This was models/dense.py's
+# inline `mode in ("dist", "xla")` predicate — now THE routing fact,
+# owned by the planner and consumed via Plan.seq_sharded.
+SEQ_SHARDED_MODES = ("dist", "xla")
+
+# fusion pattern -> the @verify.protocol skeleton covering its
+# transport. The grouped-GEMM (MoE) patterns ride the dense skeletons:
+# the verified property is the ring-AG / ring-RS HB-graph, which the
+# grouped variants share (kernels/allgather_group_gemm.py builds on the
+# same per-step semaphore ladder allgather_gemm ships).
+PATTERN_PROTOCOLS = {
+    "ag+gemm": "allgather_gemm",
+    "ag+grouped_gemm": "allgather_gemm",
+    "gemm+rs": "gemm_reduce_scatter",
+    "grouped_gemm+rs": "gemm_reduce_scatter",
+    "gemm+ar": "allreduce",
+    "a2a+grouped_gemm": "ep_dispatch_chunked",
+}
+
+# (pattern, site-prefix) -> the fused kernel plan/execute can rewrite
+# to, per lowering family. The "head" site is deliberately absent:
+# the logits path is numerics-critical (sampling reads it bitwise) and
+# stays sequential by design.
+_DIST_KERNELS = {
+    ("ag+gemm", "attn"): "ag_gemm",
+    ("ag+gemm", "mlp"): "ag_gemm",
+    ("ag+grouped_gemm", "moe"): "ag_group_gemm",
+    ("gemm+rs", "attn"): "gemm_rs",
+    ("gemm+rs", "mlp"): "gemm_rs",
+    ("grouped_gemm+rs", "moe"): "moe_reduce_rs",
+}
+_AR_KERNELS = {
+    ("gemm+rs", "attn"): "gemm_ar",
+    ("gemm+rs", "mlp"): "gemm_ar",
+}
+_FUSED_MOE_KERNELS = {
+    ("ag+grouped_gemm", "moe"): "fused_ag_moe_up",
+    ("grouped_gemm+rs", "moe"): "fused_moe_down_combine_rs",
+}
+
+_DENSE_MODES = ("dist", "ar", "xla")
+
+
+@dataclasses.dataclass(frozen=True)
+class TripleDecision:
+    """One collective site's lowering under the chosen mode.
+
+    lowered   "ag+gemm" | "gemm+rs" | "gemm+ar" | "sequential" |
+              "elided" — what the site becomes.
+    kernel    the fused kernel (or lax primitive) the site lowers to.
+    protocol  the shipped verify skeleton backing a fused pick (None
+              for sequential lowerings).
+    est_fused_ms / est_seq_ms   both prices, always recorded, so the
+              report can show the margin the decision rests on.
+    config    autotuner top-1 tile config (pricing witness; see module
+              doc).
+    """
+
+    site: str
+    pattern: str
+    lowered: str
+    fused: bool
+    kernel: str
+    protocol: Optional[str]
+    wire: str
+    est_fused_ms: float
+    est_seq_ms: float
+    config: str = ""
+    reason: str = ""
+
+    @property
+    def chosen_ms(self) -> float:
+        return self.est_fused_ms if self.fused else self.est_seq_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """The one object every consumer routes through (module doc).
+
+    mode       the attention + dense-MLP lowering ("dist"|"xla"|"ar").
+    moe_mode   the MoE FFN lowering ("dist"|"xla"|"ar"|"fused").
+    seq_sharded  whether the forward slices tokens by rank on entry
+               (mode in SEQ_SHARDED_MODES) — consumed by
+               plan/execute.shard_tokens / gather_tokens.
+    attn_impl  forced prefill impl ("xla"|"pallas") or None = the
+               per-shape `route_prefill_impl` decision at the call
+               site (still the planner's single predicate).
+    """
+
+    plan_id: str
+    key: str
+    world: int
+    chip: str
+    requested: str
+    mode: str
+    moe_mode: str
+    seq_sharded: bool
+    is_moe: bool
+    attn_impl: Optional[str]
+    decisions: Tuple[TripleDecision, ...]
+    est_layer_ms: float
+    mega_strategy: str = "least_loaded"
+
+    @property
+    def ffn_mode(self) -> str:
+        """The mode string the FFN layer call executes under."""
+        return self.moe_mode if self.is_moe else self.mode
+
+    def fused_sites(self) -> Tuple[str, ...]:
+        return tuple(d.site for d in self.decisions if d.fused)
+
+
+@functools.lru_cache(maxsize=1)
+def _shipped_protocols() -> frozenset:
+    from triton_dist_tpu.verify import registry
+
+    return frozenset(registry.load_shipped().keys())
+
+
+def _resolve_chip(rig):
+    from triton_dist_tpu import perf_model as pm
+
+    if rig is None:
+        return pm.detect_chip()
+    if isinstance(rig, pm.ChipSpec):
+        return rig
+    if rig in pm.CHIPS:
+        return pm.CHIPS[rig]
+    for spec in pm.CHIPS.values():
+        if spec.name == rig:
+            return spec
+    raise KeyError(f"unknown rig {rig!r}; expected one of "
+                   f"{sorted(set(s.name for s in pm.CHIPS.values()))}")
+
+
+def _top_config(pattern: str, cons_or_prod, world: int, chip) -> str:
+    """The autotuner's best tile config for a fused triple (top_n=1),
+    recorded as the pricing witness. Never fatal: an unpriceable shape
+    returns ''."""
+    from triton_dist_tpu import autotuner as at
+
+    node = cons_or_prod
+    try:
+        if pattern in ("ag+gemm", "ag+grouped_gemm"):
+            picks = at.prune_ag_gemm_configs(
+                node.m, node.k, node.n, dtype=node.dtype, chip=chip,
+                top_n=1)
+        elif pattern in ("gemm+rs", "grouped_gemm+rs"):
+            picks = at.prune_gemm_rs_local_configs(
+                node.m, node.k, node.n, dtype=node.dtype, chip=chip,
+                top_n=1)
+        else:
+            return ""
+        return str(picks[0]) if picks else ""
+    except Exception:  # pricing witness only — never block planning
+        return ""
+
+
+def _wire_name(node, world: int, chip, error_budget: float,
+               collective: str) -> str:
+    if not node.wire_eligible or world <= 1:
+        return "native"
+    from triton_dist_tpu.perf_model import choose_wire_format
+
+    fmt = choose_wire_format(node.bytes, world, dtype=node.dtype,
+                             error_budget=error_budget,
+                             collective=collective, chip=chip)
+    return getattr(fmt, "kind", str(fmt))
+
+
+def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
+            chip, shipped, error_budget: float, forced: bool):
+    """One TripleDecision under the (mode, moe_mode) lowering pair."""
+    from triton_dist_tpu import perf_model as pm
+
+    nodes = ir.nodes
+    node = nodes[tri.collective]
+    # the kernel family is the COMPUTE op's (the MoE block's gather is
+    # named mlp.ag but feeds moe.up — the grouped kernels own it)
+    comp = (nodes[tri.consumer] if tri.consumer >= 0
+            else nodes[tri.producer] if tri.producer >= 0 else node)
+    site = comp.name.split(".")[0]
+    site_mode = moe_mode if site == "moe" else mode
+    dtype = node.dtype
+
+    def seq(lowered, kernel, f_ms, s_ms, reason, wire="native",
+            config=""):
+        return TripleDecision(site=node.name, pattern=tri.pattern,
+                              lowered=lowered, fused=False,
+                              kernel=kernel, protocol=None, wire=wire,
+                              est_fused_ms=f_ms, est_seq_ms=s_ms,
+                              config=config, reason=reason)
+
+    def fused(lowered, kernel, proto, f_ms, s_ms, reason, wire,
+              config):
+        if proto not in shipped and not forced:
+            warnings.warn(
+                f"plan: fusion {tri.pattern!r} at {node.name} has no "
+                f"shipped verify protocol {proto!r}; falling back to "
+                f"sequential", stacklevel=2)
+            return seq("sequential", "lax." + (node.collective or "?"),
+                       f_ms, s_ms,
+                       f"unverified fusion (protocol {proto!r} not "
+                       f"shipped)", wire=wire)
+        if proto not in shipped:
+            reason += f" [forced: protocol {proto!r} not shipped]"
+            warnings.warn(
+                f"plan: forced mode keeps unverified fusion "
+                f"{tri.pattern!r} at {node.name} (protocol {proto!r} "
+                f"not shipped)", stacklevel=2)
+        return TripleDecision(site=node.name, pattern=tri.pattern,
+                              lowered=lowered, fused=True,
+                              kernel=kernel, protocol=proto, wire=wire,
+                              est_fused_ms=f_ms, est_seq_ms=s_ms,
+                              config=config, reason=reason)
+
+    if tri.pattern == "unknown":
+        coll_ms = (pm.estimate_ag_ms(node.bytes, world, chip)
+                   if node.collective == "all_gather"
+                   else pm.estimate_ar_ms(node.bytes, world, chip))
+        if node.wire_eligible:
+            # a fusable-looking site the matcher could not pair: the
+            # loud-fallback contract (tests pin this warning)
+            warnings.warn(
+                f"plan: unmatched collective {node.name} "
+                f"({node.collective}); lowering sequentially",
+                stacklevel=2)
+            reason = "unmatched collective: sequential fallback"
+        else:
+            reason = "terminal numerics-critical collective"
+        return seq("sequential", "lax." + (node.collective or "?"),
+                   coll_ms, coll_ms, reason)
+
+    wire = _wire_name(
+        node, world, chip, error_budget,
+        "allgather" if node.collective == "all_gather" else "allreduce")
+
+    if tri.pattern.startswith("ag+"):
+        cons = nodes[tri.consumer]
+        gemm_ms = pm.estimate_gemm_ms(cons.m, cons.n, cons.k,
+                                      dtype=dtype, chip=chip)
+        ag_ms = pm.estimate_ag_ms(node.bytes, world, chip)
+        s_ms = ag_ms + gemm_ms
+        if cons.kind == "gemm":
+            f_ms = pm.estimate_ag_gemm_ms(cons.m, cons.k, cons.n,
+                                          world, dtype=dtype, chip=chip)
+        else:
+            # grouped consumer: the gather moves tokens, not
+            # token*top_k rows — bound it from the node's own payload
+            f_ms = max(gemm_ms, ag_ms) + 0.1 * min(gemm_ms, ag_ms)
+        if site_mode == "ar":
+            return TripleDecision(
+                site=node.name, pattern=tri.pattern, lowered="elided",
+                fused=False, kernel="none", protocol=None,
+                wire="native", est_fused_ms=gemm_ms, est_seq_ms=gemm_ms,
+                reason="replicated activations: no gather under ar")
+        if site_mode == "xla":
+            return seq("sequential", "lax.all_gather", f_ms, s_ms,
+                       "xla lowering is the sequential reference",
+                       wire=wire)
+        kernels = (_FUSED_MOE_KERNELS if site_mode == "fused"
+                   else _DIST_KERNELS)
+        kernel = kernels.get((tri.pattern, site))
+        if kernel is None:
+            return seq("sequential", "lax.all_gather", f_ms, s_ms,
+                       "no fused rewrite for this site", wire=wire)
+        cfgstr = _top_config(tri.pattern, cons, world, chip)
+        return fused("ag+" + cons.kind, kernel,
+                     PATTERN_PROTOCOLS[tri.pattern], f_ms, s_ms,
+                     f"overlap hides min(comm, compute): "
+                     f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
+                     wire, cfgstr)
+
+    if tri.pattern.endswith("+rs") or tri.pattern.endswith("+ar"):
+        prod = nodes[tri.producer]
+        gemm_ms = pm.estimate_gemm_ms(prod.m, prod.n, prod.k,
+                                      dtype=dtype, chip=chip)
+        rs_ms = pm.estimate_rs_ms(node.bytes, world, chip)
+        ar_ms = pm.estimate_ar_ms(node.bytes, world, chip)
+        if site_mode == "ar":
+            s_ms = gemm_ms + ar_ms
+            f_ms = max(gemm_ms, ar_ms) + 0.1 * min(gemm_ms, ar_ms)
+            kernel = _AR_KERNELS.get((tri.pattern, site))
+            if kernel is None:
+                # the MoE ar path reduces with a plain psum today
+                return seq("sequential", "lax.psum", f_ms, s_ms,
+                           "no fused gemm+ar rewrite for this site",
+                           wire=wire)
+            cfgstr = _top_config(tri.pattern, prod, world, chip)
+            return fused("gemm+ar", kernel, PATTERN_PROTOCOLS["gemm+ar"],
+                         f_ms, s_ms,
+                         f"replicated lowering fuses the reduction: "
+                         f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
+                         wire, cfgstr)
+        s_ms = gemm_ms + rs_ms
+        f_ms = max(gemm_ms, rs_ms) + 0.1 * min(gemm_ms, rs_ms)
+        if site_mode == "xla":
+            return seq("sequential", "lax.psum_scatter", f_ms, s_ms,
+                       "xla lowering is the sequential reference",
+                       wire=wire)
+        kernels = (_FUSED_MOE_KERNELS if site_mode == "fused"
+                   else _DIST_KERNELS)
+        kernel = kernels.get((tri.pattern, site))
+        if kernel is None:
+            return seq("sequential", "lax.psum_scatter", f_ms, s_ms,
+                       "no fused rewrite for this site", wire=wire)
+        cfgstr = _top_config(tri.pattern, prod, world, chip)
+        return fused(tri.pattern, kernel,
+                     PATTERN_PROTOCOLS[tri.pattern], f_ms, s_ms,
+                     f"overlap hides min(comm, compute): "
+                     f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
+                     wire, cfgstr)
+
+    # a2a+grouped_gemm (the EP plane) and anything future: the EP
+    # chunked pipeline is planned by plan_ep_chunks; in a layer IR it
+    # lowers sequentially here
+    coll_ms = pm.estimate_a2a_ms(node.bytes, world, chip=chip) \
+        if hasattr(pm, "estimate_a2a_ms") else 0.0
+    return seq("sequential", "lax.all_to_all", coll_ms, coll_ms,
+               "EP transport planned by plan_ep_chunks", wire=wire)
+
+
+def _decisions_for(ir, triples, mode, moe_mode, world, chip, shipped,
+                   error_budget, forced):
+    return tuple(_decide(ir, t, mode, moe_mode, world, chip, shipped,
+                         error_budget, forced) for t in triples)
+
+
+# norm/residual passes over the token rows per block: ~2 rms_norms and
+# ~2 residual adds, each streaming read+read+write of (rows, H)
+_ELEMENTWISE_PASSES = 12
+
+
+def _elementwise_ms(ir: LayerIR, mode: str, world: int, chip) -> float:
+    """The replicated-lowering tax the collectives ledger cannot see:
+    sequence-sharded modes run norms + residuals on m/n rows, "ar"
+    runs them on all m rows on every rank. This is the term that makes
+    "ar" the decode pick and "dist" the prefill pick — exactly the
+    engine's hand defaults."""
+    from triton_dist_tpu.plan.ir import _dtype_bytes
+
+    h = next((nd.k for nd in ir.nodes if nd.kind == "gemm"), 0)
+    if not h:
+        return 0.0
+    rows = ir.tokens if mode == "ar" else ir.tokens // max(world, 1)
+    nbytes = rows * h * _dtype_bytes(ir.nodes[0].dtype)
+    return nbytes * _ELEMENTWISE_PASSES / (chip.hbm_gbps * 1e9) * 1e3
+
+
+def plan_forward(ir: LayerIR, world: Optional[int] = None,
+                 rig=None, mode: str = "auto",
+                 attn_impl: Optional[str] = None,
+                 error_budget: float = 0.0) -> Plan:
+    """THE planning pass (ISSUE: one `plan_forward(ir, world, rig)`).
+
+    mode "auto" prices the candidate lowerings and picks the cheapest;
+    a legacy mode string ("dist" | "xla" | "ar" | MoE "fused") is a
+    constraint honored exactly — that is the bit-identity contract with
+    the hand-routed paths. Token counts not divisible by `world`
+    restrict candidates to "ar" (the sequence-sharded lowerings slice
+    tokens by rank). error_budget feeds choose_wire_format per
+    collective; the default 0.0 forces native wire (bitwise execution).
+    """
+    world = ir.world if world is None else world
+    chip = _resolve_chip(rig)
+    shipped = _shipped_protocols()
+    forced = mode != "auto"
+
+    if mode == "fused" and not ir.is_moe:
+        raise ValueError("mode='fused' is the MoE one-kernel pipeline; "
+                         f"IR {ir.key} is dense")
+    if mode == "auto":
+        cands = (_DENSE_MODES if ir.tokens % max(world, 1) == 0
+                 else ("ar",))
+        scored = []
+        for m in cands:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ds = _decisions_for(ir, find_triples(ir), m, m, world,
+                                    chip, shipped, error_budget, False)
+            scored.append((sum(d.chosen_ms for d in ds)
+                           + _elementwise_ms(ir, m, world, chip), m))
+        # stable min: candidate order breaks ties toward "dist"
+        picked = min(scored, key=lambda t: t[0])[1]
+        chosen_mode, chosen_moe = picked, picked
+    elif mode == "fused":
+        # the one-kernel MoE pipeline is sequence-sharded; attention
+        # rides the dist lowering beside it
+        chosen_mode, chosen_moe = "dist", "fused"
+    else:
+        if mode not in _DENSE_MODES:
+            raise ValueError(f"unknown mode {mode!r}; expected one of "
+                             f"{_DENSE_MODES + ('fused', 'auto')}")
+        chosen_mode, chosen_moe = mode, mode
+
+    triples = find_triples(ir)
+    decisions = _decisions_for(ir, triples, chosen_mode, chosen_moe,
+                               world, chip, shipped, error_budget,
+                               forced)
+    est = (sum(d.chosen_ms for d in decisions)
+           + _elementwise_ms(ir, chosen_mode, world, chip))
+    pid = hashlib.sha1(repr((
+        ir.key, world, chip.name, mode, chosen_mode, chosen_moe,
+        attn_impl, error_budget,
+    )).encode()).hexdigest()[:12]
+    return Plan(plan_id=pid, key=ir.key, world=world, chip=chip.name,
+                requested=mode, mode=chosen_mode, moe_mode=chosen_moe,
+                seq_sharded=chosen_mode in SEQ_SHARDED_MODES,
+                is_moe=ir.is_moe, attn_impl=attn_impl,
+                decisions=decisions, est_layer_ms=est)
+
+
+@functools.lru_cache(maxsize=512)
+def _plan_dense_cached(cfg, batch, seq, world, mode, attn_impl, kv_len,
+                       rig, error_budget):
+    ir = build_dense_ir(cfg, batch, seq, world, kv_len=kv_len)
+    return plan_forward(ir, world=world, rig=rig, mode=mode,
+                        attn_impl=attn_impl, error_budget=error_budget)
+
+
+def plan_dense_forward(cfg, batch: int, seq: int, world: int,
+                       mode: str = "auto",
+                       attn_impl: Optional[str] = None,
+                       kv_len: Optional[int] = None,
+                       rig: Optional[str] = None,
+                       error_budget: float = 0.0) -> Plan:
+    """Plan one `models/dense.forward` step shape. Memoized on the
+    hashable ModelConfig + geometry, so every consumer of the same step
+    shape holds the SAME Plan object (module doc) and planning inside a
+    traced function costs a dict lookup."""
+    if rig is None:
+        rig = _resolve_chip(None).name
+    return _plan_dense_cached(cfg, batch, seq, world, mode, attn_impl,
+                              kv_len, rig, error_budget)
+
+
+def plan_ep_chunks(m: int, hidden: int, inter: int, e_loc: int, n: int,
+                   top_k: int, capacity: Optional[int] = None,
+                   dtype=None, payload_dtype=None, chip=None,
+                   overlap: bool = False) -> int:
+    """ONE EP chunking entry (the a2a+grouped_gemm plane):
+    `layers/ep_moe.py`'s n_chunks auto path routes here so the planner
+    owns the composition; `perf_model.choose_ep_chunks` stays the
+    pricing primitive."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.perf_model import choose_ep_chunks
+
+    return choose_ep_chunks(
+        m, hidden, inter, e_loc, n, top_k, capacity=capacity,
+        dtype=jnp.bfloat16 if dtype is None else dtype,
+        payload_dtype=payload_dtype, chip=chip, overlap=overlap)
+
+
+def route_prefill_impl(b: int, s: int, t: int, hq: int, hkv: int,
+                       d: int, dtype) -> str:
+    """THE prefill-impl routing predicate ("pallas" | "xla"): native
+    gate (kernels.flash_prefill.flash_prefill_native_ok — interpret
+    stays xla for CPU bit-stability), the VMEM-fit gate, then the
+    perf-model pick (perf_model.choose_prefill_impl). Moved here from
+    layers/attention.py so the planner owns every impl decision;
+    `layers.attention._route_prefill_impl` delegates."""
+    from triton_dist_tpu.kernels.flash_prefill import (
+        flash_prefill_fits,
+        flash_prefill_native_ok,
+    )
+
+    if not flash_prefill_native_ok(hq, hkv, d):
+        return "xla"
+    if not flash_prefill_fits(s, t, hq, hkv, d, dtype=dtype):
+        # per-grid-step state beyond the VMEM ceiling: the blockwise
+        # xla path handles arbitrarily long context; auto must never
+        # route into a Mosaic allocation failure
+        return "xla"
+    from triton_dist_tpu.perf_model import choose_prefill_impl
+
+    return ("pallas" if choose_prefill_impl(s, t, hq, hkv, d, batch=b,
+                                            dtype=dtype) == "flash"
+            else "xla")

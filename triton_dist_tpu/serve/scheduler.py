@@ -136,6 +136,29 @@ class Scheduler:
             assert self.spec.k + 1 <= self.chunk, (
                 f"spec k={self.spec.k} needs k+1 <= chunk "
                 f"({self.chunk}): the verify row is [last, d_1..d_k]")
+        # -- adaptive spec-k (ISSUE 17 satellite): an EWMA over the
+        # observed per-step acceptance rate, folded back through
+        # perf_model.choose_spec_k so the LIVE draft width decays to 0
+        # on non-self-similar traffic and recovers when acceptance
+        # does. spec.k stays the hard cap (the k+1 <= chunk assert and
+        # the resident ring's verify records are sized for it, so
+        # adaptation may only narrow rows). Emitted tokens are bitwise
+        # unchanged — k widens/narrows what is PROPOSED, and every
+        # accepted token is the model's own emission.
+        self._spec_ewma: Optional[float] = None
+        self._spec_k_live: Optional[int] = None
+        self._spec_geom: Optional[dict] = None
+        if self.spec is not None and getattr(self.spec, "adaptive",
+                                             False):
+            cfg = engine.cfg
+            n = int(engine.mesh.shape[engine.axis])
+            self._spec_geom = dict(
+                num_layers=cfg.num_layers, hidden=cfg.hidden_size,
+                inter_loc=cfg.intermediate_size // n,
+                hq_loc=cfg.num_q_heads // n,
+                hkv_loc=cfg.num_kv_heads // n, head_dim=cfg.head_dim,
+                vocab_loc=cfg.vocab_size // n, slots=slots,
+                kv_tokens=self.pool.t_max, dtype=cfg.dtype)
         # -- radix prefix cache (ISSUE 14, serve/prefix.py): admission
         # matches the prompt against cached token blocks and skips
         # prefill for the hit (KVPool.share — copy-on-write refcounted
@@ -220,6 +243,13 @@ class Scheduler:
         # queue is falsy) — the admission-control settings a caller
         # configured (max_pending backpressure) would vanish
         self.queue = queue if queue is not None else RequestQueue()
+        # -- the fusion plan (ISSUE 17): the scheduler holds the SAME
+        # memoized Plan object the engine's decode step executes under
+        # (Engine.plan_for -> plan.planner's lru cache), so metrics()
+        # and traces can tie serve throughput to the routing the
+        # planner chose. None for engine doubles without plan_for.
+        self.plan = (engine.plan_for(slots, self.chunk)
+                     if hasattr(engine, "plan_for") else None)
         self.max_active = max_active or slots
         self.detok = detokenizer
         self.active: dict = {}  # slot -> Request
@@ -357,7 +387,7 @@ class Scheduler:
                 emits = req.pos + n == len(hist)
             else:  # DECODE — possibly a spec-verify row (ISSUE 14)
                 if spec_on:
-                    cap = draft_cap(self.spec.k, C, len(hist),
+                    cap = draft_cap(self._live_spec_k(), C, len(hist),
                                     len(req.out_tokens),
                                     req.max_new_tokens, self.pool.t_max)
                     if cap > 0:
@@ -445,6 +475,7 @@ class Scheduler:
                     self.obs.inc("spec_accepted", acc)
                     self.obs.observe("spec_accept_rate",
                                      acc / len(drafts))
+                    self._note_accept_rate(acc / len(drafts))
             self.worker.advance_lengths(advance)
 
         for slot, req, n, emits, drafts in plans:
@@ -691,7 +722,7 @@ class Scheduler:
             if not self.worker.can_inject():
                 return
             hist = req.history()
-            cap = draft_cap(self.spec.k, self.chunk, len(hist),
+            cap = draft_cap(self._live_spec_k(), self.chunk, len(hist),
                             len(req.out_tokens), req.max_new_tokens,
                             self.pool.t_max)
             if cap <= 0:
@@ -701,6 +732,39 @@ class Scheduler:
             if drafts:
                 self.worker.inject_verify(
                     slot, req.request_id, len(req.out_tokens), drafts)
+
+    # -- adaptive spec-k (ISSUE 17 satellite) ---------------------------
+
+    def _note_accept_rate(self, rate: float) -> None:
+        """Fold one verify step's acceptance into the adaptive-k EWMA
+        (a no-op unless SpecConfig.adaptive). Both spec planes report
+        here: the host plan loop after each verify row, and
+        _drain_records per drained resident verify record."""
+        if self._spec_geom is None:
+            return
+        a = self.spec.ewma_alpha
+        prev = self._spec_ewma
+        self._spec_ewma = rate if prev is None else (
+            a * rate + (1.0 - a) * prev)
+        self._spec_k_live = None  # re-priced lazily at next draft_cap
+
+    def _live_spec_k(self) -> int:
+        """The draft width the NEXT verify row may carry: spec.k until
+        the EWMA has evidence, then choose_spec_k(accept_rate=ewma)
+        capped at spec.k (the chunk assert and the resident ring's
+        verify records are sized for spec.k — adaptation only narrows).
+        choose_spec_k is monotone in accept_rate, so sustained
+        non-self-similar traffic decays the live k to 0 (spec
+        effectively OFF) and self-similar traffic restores it."""
+        if self._spec_geom is None or self._spec_ewma is None:
+            return self.spec.k
+        if self._spec_k_live is None:
+            from triton_dist_tpu.perf_model import choose_spec_k
+
+            self._spec_k_live = min(self.spec.k, choose_spec_k(
+                accept_rate=self._spec_ewma, k_max=self.spec.k,
+                **self._spec_geom))
+        return self._spec_k_live
 
     def _reap_cancelled_resident(self) -> None:
         """Cancellation, resident form: the retirement travels as a
@@ -875,6 +939,7 @@ class Scheduler:
                 self.obs.inc("spec_proposed", kd)
                 self.obs.inc("spec_accepted", acc)
                 self.obs.observe("spec_accept_rate", acc / kd)
+                self._note_accept_rate(acc / kd)
 
     def _count_guard_trips(self, err) -> None:
         """Guard-trip counters by wait site (the decoded rows a
@@ -1043,6 +1108,13 @@ class Scheduler:
         out["spec_accept_rate"] = round(
             out["spec_accepted"] / out["spec_proposed"], 4
         ) if out["spec_proposed"] else 0.0
+        # the LIVE draft width (adaptive spec-k, ISSUE 17): equals the
+        # configured k until the EWMA has evidence or when adaptation
+        # is off; 0 when the spec plane is off entirely
+        out["spec_k_live"] = (self._live_spec_k()
+                              if self.spec is not None else 0)
+        if self.plan is not None:
+            out["plan_id"] = self.plan.plan_id
         if self.resident:
             out["resident_windows"] = snap.get(
                 "serve_resident_windows", 0)

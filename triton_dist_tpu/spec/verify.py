@@ -43,13 +43,27 @@ class SpecConfig:
              perf_model.choose_spec_k picks k from the acceptance
              rate).
     draft    the proposer (defaults to prompt-lookup NgramDraft).
+    adaptive feed the LIVE acceptance rate (an EWMA over the
+             scheduler's spec_accept_rate observations) back into
+             perf_model.choose_spec_k, so the draft width decays to 0
+             on non-self-similar traffic and recovers when acceptance
+             returns (ROADMAP item 4 follow-up). `k` stays the hard
+             cap (the resident ring's verify records are sized for
+             it); adaptation only narrows rows. Emitted tokens are
+             bitwise unaffected — k changes what is PROPOSED, and
+             every accepted token is the model's own emission.
+    ewma_alpha  weight of the newest verify step in the EWMA.
     """
 
     k: int = 4
     draft: Draft = dataclasses.field(default_factory=NgramDraft)
+    adaptive: bool = False
+    ewma_alpha: float = 0.2
 
     def __post_init__(self):
         assert self.k >= 0, f"spec k must be >= 0, got {self.k}"
+        assert 0.0 < self.ewma_alpha <= 1.0, (
+            f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}")
 
 
 def draft_cap(k: int, chunk: int, history_len: int, n_out: int,

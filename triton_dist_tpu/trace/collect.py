@@ -75,6 +75,7 @@ class Timeline:
     drops: Dict[Tuple[str, int, int], int]  # (stream, rank, lane) -> n
     host_spans: List[Tuple[str, int, int]]  # (name, t0_ns, t1_ns)
     label: str = "trace"
+    plan_id: Optional[str] = None  # fusion plan provenance (plan.Plan)
 
     def streams(self):
         return sorted({e.stream for e in self.events})
@@ -165,7 +166,8 @@ def _pair_spans(events: List[Event],
 
 def assemble(buffers: Dict[str, np.ndarray],
              label: str = "trace",
-             host_spans=None) -> Timeline:
+             host_spans=None,
+             plan_id: Optional[str] = None) -> Timeline:
     """Build a Timeline from {stream: buffer array}. Each value may be
     one buffer (1+cap, WORDS), a stack (k, 1+cap, WORDS) — e.g. the
     shard_map-stacked per-rank outputs — or any higher-rank stack, which
@@ -193,8 +195,12 @@ def assemble(buffers: Dict[str, np.ndarray],
                        evs[0].lane if evs else 0)
                 drops[key] = drops.get(key, 0) + dropped
     all_events.sort(key=lambda e: (e.stream, e.rank, e.lane, e.seq))
+    if plan_id is None:
+        # the plan noted by the forward traced under this build, if any
+        plan_id = ev.last_plan()
     return Timeline(all_events, all_spans, drops,
-                    list(host_spans or []), label=label)
+                    list(host_spans or []), label=label,
+                    plan_id=plan_id)
 
 
 class TraceSession:

@@ -167,6 +167,23 @@ class TraceBuild:
 
 _BUILD: Optional[TraceBuild] = None
 
+# the fusion plan whose execution the trace covers (plan.Plan.plan_id):
+# models/dense.forward notes it at trace time, collect.assemble stamps
+# it onto the Timeline so exported spans carry provenance
+_LAST_PLAN: Optional[str] = None
+
+
+def note_plan(plan_id: Optional[str]) -> None:
+    """Record the plan-id of the forward being traced (host-side,
+    trace-time). The most recent note wins — one Timeline covers one
+    step shape, which holds one Plan."""
+    global _LAST_PLAN
+    _LAST_PLAN = plan_id
+
+
+def last_plan() -> Optional[str]:
+    return _LAST_PLAN
+
 
 def active_build() -> Optional[TraceBuild]:
     """The build in effect at TRACE time (None = tracing off). Kernels
@@ -184,9 +201,10 @@ def building(cap: int = 512):
     trace buffer (per core for the megakernel) — which the caller feeds
     to trace.collect.assemble. Default builds return exactly their
     documented outputs."""
-    global _BUILD
+    global _BUILD, _LAST_PLAN
     prev = _BUILD
     _BUILD = TraceBuild(cap=int(cap))
+    _LAST_PLAN = None  # a fresh build must not inherit a stale plan-id
     try:
         yield _BUILD
     finally:

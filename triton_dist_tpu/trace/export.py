@@ -86,8 +86,11 @@ def to_chrome_trace(tl: Timeline) -> dict:
                                        "trace"),
             "ts": offs[sp.stream] + sp.t0 * _TICK_US,
             "dur": max(sp.dur, 0.001) * _TICK_US,
-            "args": {"payload": sp.payload, "aux": sp.aux,
-                     "seq_ticks": sp.dur},
+            "args": ({"payload": sp.payload, "aux": sp.aux,
+                      "seq_ticks": sp.dur, "plan": tl.plan_id}
+                     if tl.plan_id else
+                     {"payload": sp.payload, "aux": sp.aux,
+                      "seq_ticks": sp.dur}),
         })
     for e in tl.events:
         if e.kind != ev.KIND_INSTANT:
@@ -119,6 +122,7 @@ def to_chrome_trace(tl: Timeline) -> dict:
             "drops": {f"{k[0]}/r{k[1]}/c{k[2]}": v
                       for k, v in tl.drops.items()},
             "format": "triton_dist_tpu.trace v1",
+            **({"plan": tl.plan_id} if tl.plan_id else {}),
         },
     }
 
