@@ -1366,6 +1366,164 @@ def bench_prefix_ttft(mesh, prompt_len=96, gen_len=4, pairs=5,
     }
 
 
+def bench_xslice_disagg(mesh, n_requests=8, prompt_len=48, gen_len=16,
+                        cfg=None, ctx=None):
+    """Disaggregated prefill/decode (ISSUE 18): the same submissions
+    through a single role="both" Scheduler and through a DisaggPair
+    (prefill slice -> wire-coded KV migration -> decode slice) over the
+    same engine. `xslice_disagg_vs_single_tokens` is the tokens/s
+    ratio (the serialization tax the migration hop adds on this
+    single-host rig — on real disaggregated slices the two sides run
+    concurrently and the ratio reads isolation, not tax);
+    `xslice_migration_ttft_us` is the pair's median TTFT (the first
+    token TRAVELS, so TTFT includes the migrate + admit phases), and
+    `xslice_migrate_us` / `xslice_admit_us` are the median per-request
+    phase times from the five-phase ledger. Pair tokens are asserted
+    bitwise equal to the single-scheduler run in-arm — the bit-identity
+    oracle (tests/test_xslice.py pins the same plus sampled)."""
+    import time as _time
+
+    from triton_dist_tpu.serve import Scheduler
+    from triton_dist_tpu.xslice import DisaggPair
+
+    cfg = cfg or _shard_cfg()
+    ctx = ctx or CTX
+    eng = Engine(cfg, mesh, decode_mode="ar", max_len=ctx,
+                 fast_init=True)
+    geo = dict(slots=4, chunk=64, page=64)
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    # compile outside the timed runs
+    warm = Scheduler(eng, **geo)
+    warm.submit(prompts[0][: geo["chunk"]], max_new_tokens=2)
+    warm.run()
+
+    single = Scheduler(eng, **geo)
+    for p in prompts:
+        single.submit(p, max_new_tokens=gen_len)
+    t0 = _time.perf_counter()
+    single.run()
+    t_single = _time.perf_counter() - t0
+    ref = [r.out_tokens for r in single.requests]
+    n_tok = sum(len(t) for t in ref)
+
+    pair = DisaggPair(eng, prefill_kw=dict(geo), decode_kw=dict(geo))
+    reqs = [pair.submit(p, max_new_tokens=gen_len) for p in prompts]
+    t0 = _time.perf_counter()
+    pair.run()
+    t_pair = _time.perf_counter() - t0
+    for r, toks in zip(reqs, ref):
+        assert r.out_tokens == toks, (
+            "disaggregated tokens diverged bitwise from the "
+            "single-scheduler run")
+    single_tps = n_tok / max(t_single, 1e-9)
+    pair_tps = sum(len(r.out_tokens) for r in reqs) / max(t_pair, 1e-9)
+    mig = [r.phase_ns.get("migrate", 0) / 1e3 for r in reqs]
+    adm = [r.phase_ns.get("admit", 0) / 1e3 for r in reqs]
+    ttft = [r.ttft_us() for r in reqs if r.ttft_us() is not None]
+    return {
+        "xslice_single_tokens_per_s": round(single_tps, 2),
+        "xslice_disagg_tokens_per_s": round(pair_tps, 2),
+        "xslice_disagg_vs_single_tokens": round(
+            pair_tps / max(single_tps, 1e-9), 4),
+        "xslice_migration_ttft_us": round(float(np.median(ttft)), 2),
+        "xslice_migrate_us": round(float(np.median(mig)), 2),
+        "xslice_admit_us": round(float(np.median(adm)), 2),
+    }
+
+
+def bench_xslice_collectives(slices=2, n_local=2, shape=(64, 512),
+                             iters=30):
+    """2-level (ICI + DCN) vs flat 1-level collectives (ISSUE 18) on a
+    (slices, n_local) virtual mesh built IN-PROCESS — run this through
+    `--xslice-coll` (a subprocess with the forced device count; see
+    _bench_xslice_coll_subprocess) when the parent rig holds fewer
+    devices. Ratios are hier/flat wall time over `iters` calls; on the
+    CPU interpreter they read dispatch structure (two nested exchanges
+    vs one), NOT DCN economics — perf_model.estimate_xslice_collective_ms
+    is the bandwidth story, this arm pins the dispatch tax trend."""
+    import time as _time
+
+    from jax import lax
+
+    from triton_dist_tpu.xslice import (make_xslice_mesh,
+                                        hier_all_gather_op,
+                                        hier_reduce_scatter_op)
+
+    mesh2 = make_xslice_mesh(slices, n_local)
+    n = slices * n_local
+    rng = np.random.default_rng(7)
+    dt = jnp.bfloat16
+
+    def med_ms(fn, x):
+        fn(x).block_until_ready()  # compile + warm
+        ts = []
+        for _ in range(iters):
+            t0 = _time.perf_counter()
+            fn(x).block_until_ready()
+            ts.append((_time.perf_counter() - t0) * 1e3)
+        return float(np.median(ts))
+
+    flat_jit = {}
+
+    def flat(collective, x):
+        if collective not in flat_jit:
+            if collective == "allgather":
+                def fn(xs):
+                    return lax.all_gather(xs, ("dcn", "tp"), axis=0,
+                                          tiled=True)
+                out = P()
+            else:
+                def fn(xs):
+                    return lax.psum_scatter(xs[0], ("dcn", "tp"),
+                                            scatter_dimension=0,
+                                            tiled=True)
+                out = P(("dcn", "tp"))
+            flat_jit[collective] = jax.jit(jax.shard_map(
+                fn, mesh=mesh2, in_specs=P(("dcn", "tp")),
+                out_specs=out, check_vma=False))
+        return flat_jit[collective](x)
+
+    xg = jnp.asarray(rng.standard_normal((n * shape[0], shape[1])), dt)
+    ag_ms = med_ms(lambda a: hier_all_gather_op(a, mesh2), xg)
+    flat_ag_ms = med_ms(lambda a: flat("allgather", a), xg)
+    xr = jnp.asarray(rng.standard_normal((n, n * shape[0], shape[1])),
+                     dt)
+    rs_ms = med_ms(lambda a: hier_reduce_scatter_op(a, mesh2), xr)
+    flat_rs_ms = med_ms(lambda a: flat("reduce_scatter", a), xr)
+    return {
+        "xslice_ag_ms": round(ag_ms, 4),
+        "xslice_flat_ag_ms": round(flat_ag_ms, 4),
+        "xslice_ag_vs_flat": round(ag_ms / max(flat_ag_ms, 1e-9), 4),
+        "xslice_rs_ms": round(rs_ms, 4),
+        "xslice_flat_rs_ms": round(flat_rs_ms, 4),
+        "xslice_rs_vs_flat": round(rs_ms / max(flat_rs_ms, 1e-9), 4),
+    }
+
+
+def _bench_xslice_coll_subprocess(timeout=600):
+    """Run bench_xslice_collectives in a child interpreter with the
+    forced 8-device CPU pool (device count is fixed at jax import, so
+    the world1 rig cannot host a (2, 2) mesh in-process)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "--xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, __file__, "--xslice-coll"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"--xslice-coll child failed: {out.stderr.strip()[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 TRACE_OVERHEAD_CEIL = 0.03  # hard guard on --trace instrumentation cost
 FAULTS_OVERHEAD_CEIL = 0.03  # hard guard on --faults watchdog cost
 OBS_OVERHEAD_CEIL = 0.03    # hard guard on --obs stat-row metering cost
@@ -1675,6 +1833,16 @@ _NUMERIC_KEYS = {
     "plan_prefill_ms", "plan_hand_prefill_ms", "plan_vs_hand_prefill",
     "plan_decode_ms", "plan_hand_decode_ms", "plan_vs_hand_decode",
     "plan_misroute_ms", "plan_recover_misroute_ratio",
+    # disaggregated prefill/decode + 2-level collectives (ISSUE 18):
+    # the disagg-vs-single tokens ratio (bit-identity asserted in-arm)
+    # with the migration TTFT decomposition from the five-phase
+    # ledger, and the hier-vs-flat collective dispatch-tax pair on the
+    # (2, 2) virtual mesh (keys travel together per family)
+    "xslice_single_tokens_per_s", "xslice_disagg_tokens_per_s",
+    "xslice_disagg_vs_single_tokens", "xslice_migration_ttft_us",
+    "xslice_migrate_us", "xslice_admit_us",
+    "xslice_ag_ms", "xslice_flat_ag_ms", "xslice_ag_vs_flat",
+    "xslice_rs_ms", "xslice_flat_rs_ms", "xslice_rs_vs_flat",
 }
 # the --faults keys travel together (an overhead claim without its trip
 # audit — or vice versa — is unfalsifiable from the artifact)
@@ -1748,6 +1916,21 @@ _PLAN_KEYS = {
     "plan_prefill_ms", "plan_hand_prefill_ms", "plan_vs_hand_prefill",
     "plan_decode_ms", "plan_hand_decode_ms", "plan_vs_hand_decode",
     "plan_misroute_ms", "plan_recover_misroute_ratio",
+}
+# the disagg-serving family travels together: the ratio without both
+# absolute tokens/s arms, or the migration TTFT without its phase
+# decomposition, is unfalsifiable from the artifact
+_XSLICE_KEYS = {
+    "xslice_single_tokens_per_s", "xslice_disagg_tokens_per_s",
+    "xslice_disagg_vs_single_tokens", "xslice_migration_ttft_us",
+    "xslice_migrate_us", "xslice_admit_us",
+}
+# the hier-vs-flat collective family likewise (each ratio with both
+# absolute arms, and AG with RS — one protocol alone could hide a
+# regression in the other's exchange structure)
+_XSLICE_COLL_KEYS = {
+    "xslice_ag_ms", "xslice_flat_ag_ms", "xslice_ag_vs_flat",
+    "xslice_rs_ms", "xslice_flat_rs_ms", "xslice_rs_vs_flat",
 }
 
 
@@ -1863,6 +2046,18 @@ def check_result(result: dict) -> list:
             problems.append(
                 f"prefix-ttft keys travel together: {k!r} missing "
                 f"while {sorted(pfx_present)[0]!r} is present")
+    xsl_present = _XSLICE_KEYS & set(result)
+    if xsl_present:
+        for k in _XSLICE_KEYS - set(result):
+            problems.append(
+                f"xslice-disagg keys travel together: {k!r} missing "
+                f"while {sorted(xsl_present)[0]!r} is present")
+    xslc_present = _XSLICE_COLL_KEYS & set(result)
+    if xslc_present:
+        for k in _XSLICE_COLL_KEYS - set(result):
+            problems.append(
+                f"xslice-collective keys travel together: {k!r} "
+                f"missing while {sorted(xslc_present)[0]!r} is present")
     pln_present = _PLAN_KEYS & set(result)
     if pln_present:
         for k in _PLAN_KEYS - set(result):
@@ -2081,6 +2276,22 @@ def _main_cpu_rig(mesh):
         result.update(bench_plan_vs_hand(mesh, cfg=cfg, ctx=_RIG_CTX))
     except Exception as e:
         result["plan_vs_hand_error"] = str(e)[:200]
+    try:
+        # disaggregated prefill/decode (ISSUE 18): same rig shard +
+        # per-request geometry as the serving arms, so the
+        # disagg-vs-single ratio reads the migration hop, not page
+        # depth
+        result.update(bench_xslice_disagg(
+            mesh, n_requests=8, prompt_len=48, gen_len=32, cfg=cfg,
+            ctx=_RIG_CTX))
+    except Exception as e:
+        result["xslice_error"] = str(e)[:200]
+    try:
+        # hier-vs-flat collectives need a (2, 2) mesh the world1 rig
+        # cannot host — the child interpreter forces an 8-device pool
+        result.update(_bench_xslice_coll_subprocess())
+    except Exception as e:
+        result["xslice_coll_error"] = str(e)[:200]
     try:
         # iterations are sub-ms at this shape, so the chains can be
         # long: short ks flipped the slope sign run-to-run under the
@@ -2332,4 +2543,9 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--xslice-coll" in sys.argv:
+        # child-interpreter mode for _bench_xslice_coll_subprocess:
+        # one JSON line on stdout, nothing else
+        print(json.dumps(bench_xslice_collectives()))
+        sys.exit(0)
     main()

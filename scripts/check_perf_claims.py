@@ -80,6 +80,9 @@ REQUIRED_CLAIMS = (
     # misroute are the planner's load-bearing measurements
     ("plan_vs_hand_prefill", "docs/performance.md"),
     ("plan_recover_misroute_ratio", "docs/performance.md"),
+    # disaggregated prefill/decode + 2-level collectives (ISSUE 18)
+    ("xslice_disagg_vs_single_tokens", "docs/serving.md"),
+    ("xslice_ag_vs_flat", "docs/performance.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still
@@ -103,6 +106,12 @@ PENDING_FIRST_ARTIFACT = {
     # bites only if a later round drops the arms, and dies at round 17
     "plan_vs_hand_prefill": 17,
     "plan_recover_misroute_ratio": 17,
+    # ISSUE 18: the xslice families ship before their first bench
+    # round — the newest artifact (r08) predates the arms, so the
+    # grace is LIVE until the next driver round measures them, and
+    # dies by itself at round 19
+    "xslice_disagg_vs_single_tokens": 19,
+    "xslice_ag_vs_flat": 19,
 }
 
 

@@ -89,6 +89,56 @@ def render_plan(plan, out=sys.stdout) -> None:
     w(f"  fused sites: {', '.join(plan.fused_sites()) or '(none)'}\n")
 
 
+# routing fields a --diff compares: the planner's DECISION, not its
+# prices (estimates drift with perf-model tuning; the route flipping is
+# what must never happen silently)
+_ROUTE_FIELDS = ("pattern", "lowered", "kernel", "protocol", "wire",
+                 "fused")
+
+
+def _case_key(model, batch, seq, world, rig, mode) -> str:
+    return f"{model} b={batch} s={seq} w={world} rig={rig} mode={mode}"
+
+
+def decision_table(cases) -> dict:
+    """{case_key: {site: routing-fields}} over `cases` — the committed
+    artifact --dump writes and --diff compares against."""
+    table = {}
+    for model, batch, seq, world, rig, mode in cases:
+        plan = _build_plan(model, batch, seq, world, rig, mode)
+        table[_case_key(model, batch, seq, world, rig, mode)] = {
+            d.site: {
+                "pattern": d.pattern, "lowered": d.lowered,
+                "kernel": d.kernel, "protocol": d.protocol,
+                "wire": d.wire, "fused": bool(d.fused),
+            }
+            for d in plan.decisions
+        }
+    return table
+
+
+def diff_tables(committed: dict, current: dict) -> list:
+    """Routing flips between a committed table and the current planner,
+    over cases present in BOTH (new/removed cases are reported by the
+    caller as notes, not flips — adding a case to the matrix must not
+    fail the gate retroactively)."""
+    flips = []
+    for key in sorted(set(committed) & set(current)):
+        old_sites, new_sites = committed[key], current[key]
+        for site in sorted(set(old_sites) | set(new_sites)):
+            o, n = old_sites.get(site), new_sites.get(site)
+            if o is None or n is None:
+                flips.append(f"{key}: site {site!r} "
+                             f"{'appeared' if o is None else 'vanished'}")
+                continue
+            for f in _ROUTE_FIELDS:
+                if o.get(f) != n.get(f):
+                    flips.append(
+                        f"{key}: {site} routing flipped on {f!r}: "
+                        f"{o.get(f)!r} -> {n.get(f)!r}")
+    return flips
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render fusion plans with per-triple pricing")
@@ -102,10 +152,53 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", default="auto")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="only report unverifiable fusions")
+    ap.add_argument("--dump", metavar="PATH", default=None,
+                    help="write the routing decision table as JSON "
+                         "(the artifact --diff compares against)")
+    ap.add_argument("--diff", metavar="PATH", default=None,
+                    help="exit 1 if the current planner's routing "
+                         "flipped vs the committed table at PATH "
+                         "(absent file: note + exit 0, so the gate "
+                         "bootstraps)")
     args = ap.parse_args(argv)
 
     cases = ([(args.model, args.batch, args.seq, args.world, args.rig,
                args.mode)] if args.model else list(DEFAULT_MATRIX))
+
+    if args.dump or args.diff:
+        import json
+
+        try:
+            table = decision_table(cases)
+        except (KeyError, ValueError) as e:
+            print(f"plan_report: {e}", file=sys.stderr)
+            return 2
+        if args.dump:
+            with open(args.dump, "w") as f:
+                json.dump(table, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"plan_report: wrote {len(table)} case(s) to "
+                  f"{args.dump}")
+        if args.diff:
+            try:
+                with open(args.diff) as f:
+                    committed = json.load(f)
+            except OSError:
+                print(f"plan_report: no committed table at "
+                      f"{args.diff} — run --dump and commit it to arm "
+                      "the routing gate", file=sys.stderr)
+                return 0
+            flips = diff_tables(committed, table)
+            for note in sorted(set(committed) ^ set(table)):
+                side = "committed" if note in committed else "current"
+                print(f"plan_report: note: case only in {side}: "
+                      f"{note}", file=sys.stderr)
+            for f_ in flips:
+                print(f"ROUTING FLIP: {f_}", file=sys.stderr)
+            print(f"plan_report: --diff {len(table)} case(s) vs "
+                  f"{args.diff}, {len(flips)} flip(s)")
+            return 1 if flips else 0
+        return 0
     bad = 0
     for model, batch, seq, world, rig, mode in cases:
         try:

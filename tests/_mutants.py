@@ -210,3 +210,43 @@ def _ag_ring_leaky_signal(n):
         h.wait()
     for j in range(n):
         _v.read(o.at(j))
+
+
+@_v.mutant("xslice_rail_before_rs", expect=_v.RACE, ns=(4,),
+           grid=({"slices": 2},),
+           doc="2-level RS with the DCN rail puts issued BEFORE the "
+               "intra-slice ring RS finishes: the ICI leg re-stages "
+               "the rail block while the DCN DMA is still READING it "
+               "(no send wait between the hoisted put and the "
+               "re-stage) — corrupts only under slice skew; the "
+               "shipped xslice_reduce_scatter orders the rail hop "
+               "behind the completed ICI leg")
+def _xslice_rail_before_rs(n, slices=2):
+    from triton_dist_tpu.kernels.reduce_scatter import _rs_protocol
+    from triton_dist_tpu.runtime.init import TP_AXIS
+    from triton_dist_tpu.xslice.topo import SliceTeam
+
+    team = SliceTeam(slices, n // slices)
+    me_g = shmem.my_pe(TP_AXIS)
+    sid = team.slice_of(me_g)
+    local = team.local_of(me_g)
+    blk, inbox = _v.ref("dcn.blk"), _v.ref("dcn.inbox")
+    send = _v.sem("dcn.send_sem")
+    recv = _v.sem("dcn.recv_sem")
+    _v.write(blk.at())  # the premature stage (the partial-so-far)
+    for j in range(1, team.slices):
+        peer = ((sid + j) % team.slices) * team.n_local + local
+        shmem.putmem_nbi(inbox.at(sid), blk.at(), send.at(),
+                         recv.at(sid), peer, TP_AXIS)
+    # the defect: the ICI ring RS runs and RE-STAGES the rail block
+    # while the hoisted puts above are still reading it — no
+    # wait_send between the DMA and the overwrite
+    _rs_protocol(team.n_local, prefix="ici.", space=team)
+    _v.read(_v.ref("ici.o").at())
+    _v.write(blk.at())
+    for j in range(1, team.slices):
+        src_sid = (sid + team.slices - j) % team.slices
+        shmem.signal_wait_until(recv.at(src_sid), shmem.CMP_GE, 1)
+        _v.read(inbox.at(src_sid))
+    _v.read(blk.at())
+    _v.write(_v.ref("o").at())

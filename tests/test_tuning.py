@@ -580,3 +580,72 @@ def test_autotune_decorator():
 
 def test_get_tuner_singleton():
     assert get_tuner("same") is get_tuner("same")
+
+
+# ---------- xslice perf model (ISSUE 18) ----------
+
+
+def test_xslice_collective_estimator_structure():
+    from triton_dist_tpu import perf_model as pm
+
+    nb, n = 8 << 20, 4
+    # slices=1 degenerates to the flat ICI estimate exactly
+    assert pm.estimate_xslice_collective_ms(nb, n, 1, "allgather") \
+        == pm.estimate_ag_ms(nb, n)
+    assert pm.estimate_xslice_collective_ms(nb, n, 1, "reduce_scatter") \
+        == pm.estimate_rs_ms(nb, n)
+    # a DCN hop is never free: 2 slices strictly dearer than 1
+    for coll in ("allgather", "reduce_scatter", "allreduce"):
+        assert pm.estimate_xslice_collective_ms(nb, n, 2, coll) \
+            > pm.estimate_xslice_collective_ms(nb, n, 1, coll)
+    # slower DCN -> strictly dearer (bandwidth term is live)
+    fast = pm.estimate_xslice_collective_ms(nb, n, 2, dcn_gbps=25.0)
+    slow = pm.estimate_xslice_collective_ms(nb, n, 2, dcn_gbps=2.0)
+    assert slow > fast
+    # chunk overlap can only help a 2-leg pipeline, never beat the
+    # slower leg's serial floor
+    c1 = pm.estimate_xslice_collective_ms(nb, n, 2, dcn_gbps=2.0)
+    c4 = pm.estimate_xslice_collective_ms(nb, n, 2, dcn_gbps=2.0,
+                                          chunks=4)
+    assert c4 < c1
+    # a wire format pays codec passes but shrinks the DCN bytes: on a
+    # slow link it must win, and the saving must be bounded by the
+    # native DCN cost itself
+    wired = pm.estimate_xslice_collective_ms(nb, n, 2, dcn_gbps=2.0,
+                                             wire_format="fp8")
+    assert wired < slow
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        pm.estimate_xslice_collective_ms(nb, n, 2, "bogus")
+
+
+def test_choose_migration_format_monotone():
+    from triton_dist_tpu import perf_model as pm
+    from triton_dist_tpu.wire import codec as wcodec
+
+    page = 32 << 10
+    # zero error budget: only native is admissible
+    assert pm.choose_migration_format(page, 64, error_budget=0.0) \
+        == wcodec.NATIVE
+    # a slow DCN link with a generous budget picks the cheapest
+    # quantized format (fp8 shrinks most)
+    f = pm.choose_migration_format(page, 256, error_budget=1.0,
+                                   dcn_gbps=0.5)
+    assert f.kind == "fp8"
+    # a budget between the two drifts excludes fp8 but not int8
+    d_int8 = pm.estimate_wire_drift("int8", 1, "allgather")
+    d_fp8 = pm.estimate_wire_drift("fp8", 1, "allgather")
+    assert d_int8 < d_fp8
+    mid = (d_int8 + d_fp8) / 2
+    g = pm.choose_migration_format(page, 256, error_budget=mid,
+                                   dcn_gbps=0.5)
+    assert g.kind in ("int8", "native")
+    assert g.kind != "fp8"
+    # a fast link: the codec passes outweigh the shrink -> native
+    assert pm.choose_migration_format(page, 4, error_budget=1.0,
+                                      dcn_gbps=400.0) == wcodec.NATIVE
+    # migration estimate itself is monotone in payload and bandwidth
+    a = pm.estimate_migration_ms(1 << 20, dcn_gbps=2.0)
+    b = pm.estimate_migration_ms(2 << 20, dcn_gbps=2.0)
+    c = pm.estimate_migration_ms(1 << 20, dcn_gbps=4.0)
+    assert b > a > c

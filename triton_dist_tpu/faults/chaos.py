@@ -55,7 +55,7 @@ from triton_dist_tpu.faults.plan import (
 
 PROTOCOLS = ("two_shot_all_reduce", "all_to_all_chunked",
              "low_latency_allgather", "flash_prefill", "serve_step",
-             "serve_resident", "serve_spec")
+             "serve_resident", "serve_spec", "serve_disagg")
 FAULTS = ("none", "delayed_send", "stalled_rank", "dropped_signal",
           "bitflip_payload", "bitflip_scale")
 OK_OUTCOMES = ("detected", "recovered", "n/a")
@@ -538,6 +538,96 @@ def _run_serve_resident(mesh, fault: str, engine=None) -> CellResult:
         f"retries={m['step_retries']}")
 
 
+def _run_serve_disagg(mesh, fault: str, engine=None) -> CellResult:
+    """The DCN-hop cell (ISSUE 18): the chaos vector is the MIGRATION
+    CHANNEL between a prefill slice and a decode slice — dropped
+    records (the DCN packet-loss analog) and corrupted page images
+    (the bitflip analog), one-shot (transient) or persistent. The
+    contract is the usual polarity: transients RECOVER through the
+    resend/nack ladder with tokens bitwise the fault-free single-slice
+    reference; persistent faults exhaust the retry budget and FAIL the
+    request loudly (detected). Any token that did stream must be a
+    bitwise prefix of the reference — silent-wrong is the only losing
+    outcome."""
+    from triton_dist_tpu.serve import Scheduler
+    from triton_dist_tpu.xslice import DisaggPair
+
+    if engine is None:
+        return CellResult("serve_disagg", fault, "n/a",
+                          "no engine provided")
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, engine.cfg.vocab_size, k).tolist()
+               for k in (5, 9)]
+    geo = dict(slots=2, chunk=4, page=8)
+
+    ref = Scheduler(engine, **geo)
+    ref_reqs = [ref.submit(p, max_new_tokens=4) for p in prompts]
+    ref.run()
+
+    pair = DisaggPair(
+        engine,
+        prefill_kw=dict(max_migration_retries=2,
+                        migration_resend_after=2, **geo),
+        decode_kw=dict(**geo))
+    ch = pair.channel
+    persistent = fault in ("dropped_signal", "stalled_rank",
+                           "bitflip_scale")
+    if fault in ("delayed_send",):
+        ch.drop_next = 1            # one lost record -> resend ladder
+    elif fault in ("dropped_signal", "stalled_rank"):
+        ch.drop_all = True          # the hop is down
+    elif fault == "bitflip_payload":
+        ch.corrupt_next = 1         # one corrupted image -> nack/resend
+    elif fault == "bitflip_scale":
+        ch.corrupt_all = True       # every image corrupt
+
+    reqs = [pair.submit(p, max_new_tokens=4) for p in prompts]
+    pair.run()
+    pm = pair.prefill.metrics()
+    dm = pair.decode.metrics()
+    # universal gate: whatever streamed must be a reference prefix
+    for r, rr in zip(reqs, ref_reqs):
+        if r.out_tokens != rr.out_tokens[:len(r.out_tokens)]:
+            return CellResult("serve_disagg", fault, "silent-wrong",
+                              f"req{r.request_id} tokens diverged")
+    if not all(r.done for r in reqs):
+        return CellResult("serve_disagg", fault, "silent-wrong",
+                          "pair drained with live requests")
+    if fault == "none":
+        ok = (all(r.out_tokens == rr.out_tokens
+                  for r, rr in zip(reqs, ref_reqs))
+              and pm["migrations_failed"] == 0
+              and dm["migrations_rejected"] == 0)
+        return CellResult("serve_disagg", fault,
+                          "recovered" if ok else "silent-wrong",
+                          f"clean run (out={pm['migrations_out']} "
+                          f"in={dm['migrations_in']})")
+    if persistent:
+        # the hop never heals: the migrated requests must FAIL loudly
+        # after the retry budget — detected, not silent
+        failed = [r for r in reqs if r.state.value == "failed"]
+        ok = (pm["migrations_failed"] >= 1 and len(failed) >= 1
+              and pm["migrations_resent"] >= 2)
+        return CellResult(
+            "serve_disagg", fault, "detected" if ok else "silent-wrong",
+            f"failed={pm['migrations_failed']} "
+            f"resent={pm['migrations_resent']} "
+            f"rejected={dm['migrations_rejected']}")
+    # transient: the ladder must absorb it and finish bitwise
+    ok = (all(r.out_tokens == rr.out_tokens
+              for r, rr in zip(reqs, ref_reqs))
+          and pm["migrations_failed"] == 0)
+    if fault == "delayed_send":
+        ok = ok and pm["migrations_resent"] >= 1 and ch.n_dropped >= 1
+    elif fault == "bitflip_payload":
+        ok = ok and dm["migrations_rejected"] >= 1 \
+            and pm["migrations_nacked"] >= 1
+    return CellResult(
+        "serve_disagg", fault, "recovered" if ok else "silent-wrong",
+        f"resent={pm['migrations_resent']} "
+        f"rejected={dm['migrations_rejected']}")
+
+
 # -- the matrix ---------------------------------------------------------------
 
 
@@ -556,6 +646,8 @@ def run_matrix(mesh, axis: str = "tp", protocols=None, faults=None,
         "serve_resident": lambda f: _run_serve_resident(
             mesh, f, engine=serve_engine),
         "serve_spec": lambda f: _run_serve_spec(
+            mesh, f, engine=serve_engine),
+        "serve_disagg": lambda f: _run_serve_disagg(
             mesh, f, engine=serve_engine),
     }
     out: List[CellResult] = []

@@ -371,7 +371,7 @@ from triton_dist_tpu import verify as _v  # noqa: E402
              doc="ring AG (_ring_ag_kernel) / full-mesh push "
                  "(fcollect); fmt != native models the same transport "
                  "over the packed wire image (_wire_ag)")
-def _ag_protocol(n, method="ring", prefix="", fmt="native"):
+def _ag_protocol(n, method="ring", prefix="", fmt="native", space=None):
     """Ring: step s forwards chunk (me-s) to the right neighbor on the
     per-step recv semaphore (a shared one would let step s's wait be
     satisfied by a step s+k arrival — the race the per-step slots
@@ -388,9 +388,21 @@ def _ag_protocol(n, method="ring", prefix="", fmt="native"):
     skeletons.
 
     `prefix` namespaces buffers/semaphores when this skeleton is
-    embedded in a larger protocol (two-shot allreduce)."""
+    embedded in a larger protocol (two-shot allreduce).
+
+    `space` (xslice.topo.SliceTeam, capture-only) scopes the ring to
+    ONE SLICE of a hierarchical team: `n` becomes the slice-local team
+    size and every peer rebases through `space.split(my_pe)` — the
+    2-level protocols in xslice/collectives.py embed this exact
+    skeleton per slice, and the verifier proves the composition at
+    every global rank. None keeps the flat behavior bit-for-bit."""
     wire = fmt != "native"
-    me = shmem.my_pe(TP_AXIS)
+    me_g = shmem.my_pe(TP_AXIS)
+    if space is None:
+        base, me = 0, me_g
+    else:
+        assert method == "ring", "slice-scoped AG models the ring only"
+        base, me = space.split(me_g)
     x, o = _v.ref(prefix + "x"), _v.ref(prefix + "out")
     lsem = _v.sem(prefix + "local_sem")
     send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sem")
@@ -404,13 +416,16 @@ def _ag_protocol(n, method="ring", prefix="", fmt="native"):
         for j in range(n):
             _v.read(o.at(j))
         return
-    shmem.neighbor_barrier(TP_AXIS, me, n)
+    if space is None:
+        shmem.neighbor_barrier(TP_AXIS, me, n)
+    else:
+        space.neighbor_barrier(prefix, me, base, n)
     lc = _v.copy(o.at(me), x.at(), lsem.at())
     lc.wait()
     for s in range(n - 1):
         slot = (me - s) % n
         h = shmem.putmem_nbi(o.at(slot), o.at(slot), send.at(),
-                             recv.at(s), (me + 1) % n, TP_AXIS)
+                             recv.at(s), base + (me + 1) % n, TP_AXIS)
         # wait our send AND the incoming chunk (me-s-1) — next step's
         # send source; program order is the dependency chain
         h.wait()

@@ -565,7 +565,8 @@ def _rs_op_jit(mesh, axis: str, method: ReduceScatterMethod, fmt):
 from triton_dist_tpu import verify as _v  # noqa: E402
 
 
-def _ring_rs_skeleton(n, fill_stage, prefix="", fmt="native"):
+def _ring_rs_skeleton(n, fill_stage, prefix="", fmt="native",
+                      space=None):
     """The shared RS producer ring protocol (_ring_rs_kernel /
     _ring_rs_wire_kernel / gemm_reduce_scatter._rs_ring): credit flow
     control toward the left neighbor, parity-indexed recv semaphores,
@@ -587,16 +588,27 @@ def _ring_rs_skeleton(n, fill_stage, prefix="", fmt="native"):
     verifier proves it by the HB chain my wait_send -> my credit grant
     -> left's credit wait -> left's next put into that slot (drop the
     credits and the race detector fires — tests/_mutants.py
-    rs_ring_no_credit)."""
+    rs_ring_no_credit).
+
+    `space` (xslice.topo.SliceTeam, capture-only) scopes the ring to
+    one slice of a hierarchical team — `n` is then the slice-local
+    size and peers rebase through `space.split(my_pe)` (see
+    allgather._ag_protocol; xslice/collectives.py composes this
+    skeleton with the DCN rail exchange). None = flat, bit-for-bit the
+    previous behavior."""
     wire = fmt != "native"
-    me = shmem.my_pe(TP_AXIS)
+    me_g = shmem.my_pe(TP_AXIS)
+    base, me = (0, me_g) if space is None else space.split(me_g)
     o = _v.ref(prefix + "o")
     acc, stage = _v.ref(prefix + "acc"), _v.ref(prefix + "stage")
     st = _v.sem(prefix + "st_sem")
     send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sems")
     credit = _v.sem(prefix + "credit_sem")
-    left, right = (me - 1) % n, (me + 1) % n
-    shmem.neighbor_barrier(TP_AXIS, me, n)
+    left, right = base + (me - 1) % n, base + (me + 1) % n
+    if space is None:
+        shmem.neighbor_barrier(TP_AXIS, me, n)
+    else:
+        space.neighbor_barrier(prefix, me, base, n)
     # step-0 incoming targets our slot 1, free from the start
     shmem.signal(credit.at(), 1, shmem.SIGNAL_ADD, left, TP_AXIS)
     # our contribution to the first travelling chunk -> acc[0]
@@ -632,16 +644,18 @@ def _ring_rs_skeleton(n, fill_stage, prefix="", fmt="native"):
              doc="credit-flow ring RS (_ring_rs_kernel; fmt != native "
                  "models _ring_rs_wire_kernel — same sync skeleton, "
                  "wire-image acc slots)")
-def _rs_protocol(n, prefix="", fmt="native"):
+def _rs_protocol(n, prefix="", fmt="native", space=None):
     x = _v.ref(prefix + "x")
     ld = _v.sem(prefix + "ld_sem")
 
     def fill_stage(s):
         # async load of our contribution; finish() runs before the read
         me = shmem.my_pe(TP_AXIS)
+        if space is not None:
+            me = space.local_of(me)  # chunk index is slice-local
         chunk = (me - 1) % n if s < 0 else (me - s - 2) % n
         dst = (_v.ref(prefix + "acc").at(0) if s < 0 and fmt == "native"
                else _v.ref(prefix + "stage").at())
         _v.copy(dst, x.at(chunk), ld.at()).wait()
 
-    _ring_rs_skeleton(n, fill_stage, prefix=prefix, fmt=fmt)
+    _ring_rs_skeleton(n, fill_stage, prefix=prefix, fmt=fmt, space=space)

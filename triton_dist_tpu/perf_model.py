@@ -438,6 +438,135 @@ def choose_wire_format(
     return wcodec.NATIVE if best_ms >= native_ms else best
 
 
+# -- 2-level ICI+DCN collectives (ISSUE 18, xslice/) -------------------------
+
+# DCN economics (EQuARX, arXiv 2506.17615): the inter-slice hop runs
+# ~30x under ICI. Bandwidth defaults from ChipSpec.dcn_gbps (a
+# deployment parameter, not a chip constant — pass `dcn_gbps` to
+# override); the latency constant models the DCN hop running orders
+# above the ICI hop.
+DCN_LATENCY_US = 50.0
+
+
+def estimate_xslice_collective_ms(
+    nbytes: int,
+    n_local: int,
+    slices: int,
+    collective: str = "allgather",
+    chip: Optional[ChipSpec] = None,
+    dcn_gbps: Optional[float] = None,
+    wire_format=None,
+    chunks: int = 1,
+    dtype=jnp.bfloat16,
+    row_width: int = 512,
+) -> float:
+    """Roofline of a 2-level (ICI + DCN) collective
+    (xslice/collectives.py). `nbytes` follows the
+    estimate_collective_wire_ms convention: per-device full tensor for
+    allreduce/reduce_scatter, per-rank shard for allgather. The ICI leg
+    prices at the existing ring estimators over `n_local`; the DCN leg
+    prices the rail exchange at `dcn_gbps` with `wire_format`'s shrink
+    (the wire rides the DCN leg ONLY — the shrink pays where the
+    transport is ~30x slower) plus the codec edge passes. `chunks > 1`
+    models the T3-style overlap: the ICI leg of chunk i+1 hides under
+    the DCN exchange of chunk i, so the pipeline costs
+    ici + dcn + (chunks-1) * max(ici, dcn) per-chunk terms instead of
+    chunks * (ici + dcn)."""
+    from triton_dist_tpu.wire import codec as wcodec
+
+    chip = chip or detect_chip()
+    chunks = max(int(chunks), 1)
+    nb = nbytes / chunks
+    shrink = wire_shrink(dtype, wire_format, row_width)
+    dcn_bw = (chip.dcn_gbps if dcn_gbps is None else dcn_gbps) * 1e9
+
+    if collective in ("allgather", "low_latency_allgather"):
+        ici_ms = estimate_ag_ms(int(nb), n_local, chip)
+        # every rank receives the other slices' whole slice blocks
+        dcn_native = (slices - 1) * n_local * nb
+    elif collective == "reduce_scatter":
+        ici_ms = estimate_rs_ms(int(nb), n_local, chip)
+        part = nb / n_local
+        dcn_native = part * (slices - 1) / max(slices, 1)
+    elif collective == "allreduce":
+        part = nb / n_local
+        ici_ms = (estimate_rs_ms(int(nb), n_local, chip)
+                  + estimate_ag_ms(int(part), n_local, chip))
+        dcn_native = 2 * part * (slices - 1) / max(slices, 1)
+    else:
+        raise ValueError(f"unknown 2-level collective {collective!r}")
+
+    if slices <= 1:
+        return chunks * ici_ms
+    dcn_ms = (dcn_native * shrink / dcn_bw * 1e3
+              + DCN_LATENCY_US * 1e-3)
+    if not wcodec.is_native(wire_format):
+        dcn_ms += (WIRE_CODEC_PASSES * dcn_native
+                   / (chip.hbm_gbps * 1e9) * 1e3)
+    return ici_ms + dcn_ms + (chunks - 1) * max(ici_ms, dcn_ms)
+
+
+def estimate_migration_ms(
+    nbytes: int,
+    dcn_gbps: Optional[float] = None,
+    wire_format=None,
+    chip: Optional[ChipSpec] = None,
+    dtype=jnp.bfloat16,
+    row_width: int = 512,
+) -> float:
+    """One KV-page migration (xslice/migrate.py): a point-to-point DCN
+    send of the page image at the format's shrink, plus the codec edge
+    passes for quantized formats. Pass `row_width=head_dim` when it is
+    known — the codec packs (rows, head_dim) KV planes, and a narrow
+    row pays lane padding that can erase the shrink entirely."""
+    from triton_dist_tpu.wire import codec as wcodec
+
+    chip = chip or detect_chip()
+    shrink = wire_shrink(dtype, wire_format, row_width)
+    bw = (chip.dcn_gbps if dcn_gbps is None else dcn_gbps) * 1e9
+    ms = nbytes * shrink / bw * 1e3 + DCN_LATENCY_US * 1e-3
+    if not wcodec.is_native(wire_format):
+        ms += WIRE_CODEC_PASSES * nbytes / (chip.hbm_gbps * 1e9) * 1e3
+    return ms
+
+
+def choose_migration_format(
+    page_bytes: int,
+    n_pages: int,
+    dtype=jnp.bfloat16,
+    error_budget: Optional[float] = None,
+    dcn_gbps: Optional[float] = None,
+    formats=("fp8", "int8"),
+    chip: Optional[ChipSpec] = None,
+    row_width: int = 512,
+):
+    """The budget-gated format chooser for KV migration: among
+    `formats` whose ONE-ROUNDTRIP drift (the image encodes once at the
+    prefill slice and decodes once at admission — no per-hop
+    requantization chain) clears `error_budget`, pick the cheapest by
+    estimate_migration_ms; native is always admissible and wins ties
+    (quantization is never free in fidelity). error_budget=None uses
+    wire.DEFAULT_ERROR_BUDGET; 0.0 forces native. Monotone both ways:
+    a tighter budget never picks a lossier format, and a slower DCN
+    never makes quantization less attractive
+    (tests/test_tuning.py)."""
+    from triton_dist_tpu.wire import codec as wcodec
+    from triton_dist_tpu.wire.numerics import DEFAULT_ERROR_BUDGET
+
+    budget = (DEFAULT_ERROR_BUDGET if error_budget is None
+              else error_budget)
+    chip = chip or detect_chip()
+    nbytes = int(page_bytes) * max(int(n_pages), 1)
+    cands = [wcodec.NATIVE] + [
+        wcodec.resolve(f) for f in formats
+        if estimate_wire_drift(f, 1, "allgather") <= budget
+    ]
+    cost = {f: estimate_migration_ms(nbytes, dcn_gbps, f, chip, dtype,
+                                     row_width) for f in cands}
+    best = min(cands, key=lambda f: cost[f])
+    return wcodec.NATIVE if cost[best] >= cost[wcodec.NATIVE] else best
+
+
 def estimate_a2a_ms(
     nbytes_per_peer: int,
     n: int,

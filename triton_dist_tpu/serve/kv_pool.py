@@ -330,6 +330,42 @@ class KVPool:
 
     # -- export ---------------------------------------------------------
 
+    def export_pages(self, slot: int, n_tokens: Optional[int] = None):
+        """Snapshot `slot`'s live KV pages as host numpy
+        (L, Hkv, n_pages, page, D) — the migration image source
+        (xslice/migrate.py). `n_tokens` trims to the pages covering the
+        first n_tokens positions (default: all of the slot's pages).
+        Pure gather; bitwise."""
+        ps = self._pages[slot]
+        assert ps is not None, f"slot {slot} is not admitted"
+        if n_tokens is not None:
+            ps = ps[:max(pages_for(n_tokens, self.page), 1)]
+        idx = jnp.asarray(ps, jnp.int32)
+        k = np.asarray(jnp.take(self.k, idx, axis=2))
+        v = np.asarray(jnp.take(self.v, idx, axis=2))
+        return k, v
+
+    def install(self, slot: int, k_pages, v_pages,
+                n_tokens: int) -> None:
+        """Admit `slot` and install migrated KV pages
+        ((L, Hkv, n_pages, page, D), the export_pages layout) covering
+        an n_tokens prefix — the destination half of the KV migration
+        handoff. Page COUNT must match the admit demand; lengths starts
+        at n_tokens (the migrated history is live). All-or-nothing:
+        raises PoolExhausted before touching device state."""
+        need = max(pages_for(n_tokens, self.page), 1)
+        assert k_pages.shape[2] == need and v_pages.shape[2] == need, (
+            f"{n_tokens} tokens need {need} pages, image has "
+            f"{k_pages.shape[2]}/{v_pages.shape[2]}"
+        )
+        self.admit(slot, n_tokens)
+        kp = jnp.asarray(k_pages, self.k.dtype)
+        vp = jnp.asarray(v_pages, self.v.dtype)
+        for i, pg in enumerate(self._pages[slot]):
+            self.k = self.k.at[:, :, pg].set(kp[:, :, i])
+            self.v = self.v.at[:, :, pg].set(vp[:, :, i])
+        self.lengths[slot] = n_tokens
+
     def to_dense(self):
         """Host-side dense (L, B, T, Hkv, D) models.KVCache snapshot
         (pure gather; bitwise — tests and the mega bridge use it)."""

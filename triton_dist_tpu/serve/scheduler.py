@@ -36,14 +36,27 @@ of that loop). Policies:
 
 Tokens stream per request (callback/iterator, incremental
 detokenization) and every lifecycle phase is recorded as a host span
-(queued/prefill/decode, eviction instants) exportable to Perfetto via
-`timeline()` — the serving extension of the trace/ subsystem.
+(queued/prefill/decode — plus migrate/admit on the disaggregated
+roles, eviction instants) exportable to Perfetto via `timeline()` —
+the serving extension of the trace/ subsystem.
+
+Disaggregated prefill/decode (ISSUE 18, docs/serving.md): with
+`role="prefill"` the scheduler runs prefill only and, at the moment a
+request would emit its first token, streams its KV pages out through
+`migrate_to` as a checksummed wire image (xslice/migrate.py) — the
+first token TRAVELS in the record instead of being emitted locally,
+so the decode slice is the stream's single producer. With
+`role="decode"` verified arrivals admit straight into DECODE via
+`admit_from` (admission gates on `decode_pages` passing — a corrupted
+image NACKs for a re-encode/resend, never admits). The pair's emitted
+tokens are bitwise the single-slice (`role="both"`) scheduler's.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import List, Optional
 
 import numpy as np
@@ -97,6 +110,12 @@ class Scheduler:
         spec=None,
         prefix_cache=False,
         prefix_block: Optional[int] = None,
+        role: str = "both",
+        migrate_to=None,
+        admit_from=None,
+        migration_format=None,
+        max_migration_retries: int = 3,
+        migration_resend_after: int = 8,
     ):
         page = page or _default_page(engine.max_len)
         self.pool = KVPool(engine, slots, page, max_pages=max_pages,
@@ -273,8 +292,40 @@ class Scheduler:
         # retired request's wall time went — streamed at retirement so
         # the /metrics scrape carries the breakdown live
         for name in ("serve_req_queued_us", "serve_req_prefill_us",
-                     "serve_req_decode_us"):
+                     "serve_req_decode_us", "serve_req_migrate_us",
+                     "serve_req_admit_us"):
             self.obs.declare_histogram(name, *LATENCY_BUCKETS)
+        # -- disaggregated prefill/decode (ISSUE 18, xslice/migrate):
+        # a "prefill" slice runs prefill only and streams finished KV
+        # pages out as checksummed wire images; a "decode" slice admits
+        # verified arrivals straight into DECODE. "both" (default) is
+        # the classic single-slice scheduler — the bit-identity
+        # reference the disaggregated pair is measured against.
+        assert role in ("both", "prefill", "decode"), role
+        self.role = role
+        if role != "both":
+            assert not self.resident, (
+                "disaggregated roles run the host loop (the resident "
+                "window has no migration hook yet — ROADMAP)")
+        assert role != "prefill" or migrate_to is not None, (
+            "role='prefill' needs a migrate_to channel")
+        assert role != "decode" or admit_from is not None, (
+            "role='decode' needs an admit_from channel")
+        self.migrate_to = migrate_to
+        self.admit_from = admit_from
+        self.migration_format = migration_format
+        self.max_migration_retries = max_migration_retries
+        self.migration_resend_after = migration_resend_after
+        self._mig_seq = 0
+        self._mig_pump_round = 0
+        # prefill side: seq -> in-flight entry (req, slot, record,
+        # retries, sent_step). The slot's pool pages stay HELD until
+        # the ack — resend/re-encode needs the source of truth.
+        self._migrating: dict = {}
+        # decode side: verified-arrival records waiting for capacity,
+        # and the seqs already admitted (dedupe of crossed resends)
+        self._pending_migrations: deque = deque()
+        self._admitted_migrations: set = set()
         # spec acceptance-rate histogram (ISSUE 14): one observation
         # per verify step, accepted/proposed in [0, 1] (a 0.0 lands in
         # the first bucket — the ladder's lo is the resolution floor)
@@ -361,9 +412,12 @@ class Scheduler:
         if self.resident:
             return self._resident_pump()
         self._reap_cancelled()
+        # prefill role: drain acks/nacks and drive the resend ladder
+        # BEFORE admitting — an ack frees a slot's pages this round
+        mig_busy = self._pump_migration()
         self._admit()
         if not self.active:
-            return False
+            return mig_busy
 
         spec_on = self.spec is not None
         K, C = self.pool.slots, self.chunk
@@ -487,10 +541,17 @@ class Scheduler:
                 if emits:
                     if self.prefix is not None:
                         self._prefix_insert(req, slot)
-                    self._phase(req, "decode")
-                    req.state = RequestState.DECODE
-                    self._emit(req, int(toks[slot, n - 1] if spec_on
-                                        else toks[slot]))
+                    first = int(toks[slot, n - 1] if spec_on
+                                else toks[slot])
+                    if self.role == "prefill":
+                        # THE handoff point: the request would emit its
+                        # first token here — instead its KV pages and
+                        # that token leave for a decode slice
+                        self._migrate_out(req, slot, first)
+                    else:
+                        self._phase(req, "decode")
+                        req.state = RequestState.DECODE
+                        self._emit(req, first)
             elif spec_on:
                 if drafts:
                     # the verify step's wall, split across the step's
@@ -1023,6 +1084,11 @@ class Scheduler:
         for slot in list(self.active):
             self._retire(self.active[slot], reason,
                          RequestState.CANCELLED)
+        for seq in list(self._migrating):
+            ent = self._migrating.pop(seq)
+            self.pool.release(ent["slot"])
+            if not ent["req"].done:
+                ent["req"]._finish(reason, RequestState.CANCELLED)
         req = self.queue.pop()
         while req is not None:
             req._finish(reason, RequestState.CANCELLED)
@@ -1039,6 +1105,8 @@ class Scheduler:
             "queue_depth": len(self.queue),
             "step_retries": self.n_step_retries,
             "quarantined": len(self.quarantined),
+            "role": self.role,
+            "migrating": len(self._migrating),
         }
 
     def _observe_step(self) -> None:
@@ -1113,6 +1181,23 @@ class Scheduler:
         # is off; 0 when the spec plane is off entirely
         out["spec_k_live"] = (self._live_spec_k()
                               if self.spec is not None else 0)
+        # disaggregated prefill/decode plane (ISSUE 18) — always
+        # present (0 when role="both") so dashboards keep the keys
+        out["role"] = self.role
+        out["migrations_out"] = snap.get("serve_migrations_out", 0)
+        out["migrations_in"] = snap.get("serve_migrations_in", 0)
+        out["migrations_acked"] = snap.get("serve_migrations_acked", 0)
+        out["migrations_nacked"] = snap.get("serve_migrations_nacked",
+                                            0)
+        out["migrations_resent"] = snap.get("serve_migrations_resent",
+                                            0)
+        out["migrations_failed"] = snap.get("serve_migrations_failed",
+                                            0)
+        out["migrations_rejected"] = sum(
+            v for k, v in snap.items()
+            if k.startswith("serve_migrations_rejected"))
+        out["migrations_inflight"] = len(self._migrating)
+        out["migrations_pending_admit"] = len(self._pending_migrations)
         if self.plan is not None:
             out["plan_id"] = self.plan.plan_id
         if self.resident:
@@ -1261,7 +1346,175 @@ class Scheduler:
             self.prefix.misses += 1
             self.obs.inc("serve_prefix_misses")
 
+    # -- disaggregated prefill/decode (ISSUE 18) ------------------------
+
+    def _migrate_out(self, req: Request, slot: int,
+                     first_token: int) -> None:
+        """Prefill-role handoff: encode the slot's KV pages as a
+        checksummed wire image and ship them (+ the first token) to the
+        decode slice. The slot leaves `active` but its pool pages stay
+        HELD until the ack — the resend/re-encode ladder reads them. A
+        request that RETIRES on its first token (max_new_tokens == 1 or
+        eos) has no decode work to hand off: it finishes locally,
+        bitwise the single-slice run."""
+        from triton_dist_tpu.xslice.migrate import (
+            MigrationRecord, encode_pages,
+        )
+
+        if req.max_new_tokens <= 1 or (req.eos_id is not None
+                                       and first_token == req.eos_id):
+            self._phase(req, "decode")
+            req.state = RequestState.DECODE
+            self._emit(req, first_token)  # retires via _emit
+            return
+        self._phase(req, "migrate")
+        n_tokens = len(req.prompt)
+        k, v = self.pool.export_pages(slot, n_tokens)
+        payload = encode_pages(k, v, self.migration_format)
+        seq = self._mig_seq
+        self._mig_seq += 1
+        rec = MigrationRecord(
+            seq=seq, request_id=req.request_id,
+            prompt=tuple(req.prompt), n_tokens=n_tokens,
+            first_token=first_token, payload=payload,
+            meta=dict(max_new_tokens=req.max_new_tokens,
+                      temperature=req.temperature, seed=req.seed,
+                      eos_id=req.eos_id, priority=req.priority),
+            req=req)
+        del self.active[slot]
+        self._migrating[seq] = dict(req=req, slot=slot, record=rec,
+                                    retries=0,
+                                    sent_step=self._mig_pump_round)
+        self.migrate_to.send(rec)
+        self.obs.inc("serve_migrations_out")
+
+    def _pump_migration(self) -> bool:
+        """Prefill-role ack pump + resend ladder. An ack releases the
+        held pages; a nack RE-ENCODES from the still-held pages and
+        resends; an unacked record resends after `resend_after` own
+        steps; the retry budget exhausting fails the request loudly
+        (never silently). Returns True while migrations are in
+        flight (keeps step() reporting work to do)."""
+        if self.role != "prefill" or not self._migrating:
+            return bool(self._migrating)
+        # resend aging counts PUMP rounds, not device steps — an
+        # otherwise-idle prefill slice (nothing left to prefill) never
+        # advances worker.n_steps, and the ladder must still fire
+        self._mig_pump_round += 1
+        for verb, seq in self.migrate_to.pump_acks():
+            ent = self._migrating.get(seq)
+            if ent is None:
+                continue  # duplicate ack after a resend race
+            if verb == "ack":
+                self._migrating.pop(seq)
+                self.pool.release(ent["slot"])
+                self.obs.inc("serve_migrations_acked")
+            else:  # nack: corrupted arrival — re-encode and resend
+                self.obs.inc("serve_migrations_nacked")
+                self._mig_resend(seq, ent, reencode=True)
+        for seq, ent in list(self._migrating.items()):
+            if (self._mig_pump_round - ent["sent_step"]
+                    >= self.migration_resend_after):
+                self._mig_resend(seq, ent, reencode=False)
+        return bool(self._migrating)
+
+    def _mig_resend(self, seq: int, ent: dict, reencode: bool) -> None:
+        from triton_dist_tpu.xslice.migrate import encode_pages
+
+        ent["retries"] += 1
+        if ent["retries"] > self.max_migration_retries:
+            self._migrating.pop(seq)
+            self.pool.release(ent["slot"])
+            req = ent["req"]
+            req._finish(
+                f"migration failed after {self.max_migration_retries} "
+                "retries", RequestState.FAILED)
+            self.obs.inc("serve_migrations_failed")
+            return
+        if reencode:
+            rec = ent["record"]
+            k, v = self.pool.export_pages(ent["slot"], rec.n_tokens)
+            rec.payload = encode_pages(k, v, self.migration_format)
+        ent["sent_step"] = self._mig_pump_round
+        self.migrate_to.send(ent["record"])
+        self.obs.inc("serve_migrations_resent")
+
+    def _admit_migrated(self) -> None:
+        """Decode-role admission: verified arrivals first (they already
+        spent a prefill slice's work), then the local queue. Admission
+        GATES on decode_pages — a corrupted image NACKs and is dropped
+        here; capacity shortfall parks the verified record until pages
+        free."""
+        from triton_dist_tpu.xslice.migrate import (
+            MigrationError, decode_pages,
+        )
+
+        if self.role != "decode":
+            return
+        while len(self.active) < self.max_active:
+            if self._pending_migrations:
+                rec = self._pending_migrations.popleft()
+            else:
+                rec = self.admit_from.recv()
+                if rec is None:
+                    return
+            if rec.seq in self._admitted_migrations:
+                # a resend crossed our ack in flight: re-ack, drop dup
+                self.admit_from.ack(rec.seq)
+                continue
+            slot = self.pool.free_slot()
+            if slot is None or self.pool.free_pages() < max(
+                    pages_for(rec.n_tokens, self.pool.page), 1):
+                self._pending_migrations.appendleft(rec)
+                return
+            # the passenger (in-process pair) moves phases now; a
+            # cross-process record has no req yet — it is only built
+            # once the image VERIFIES (no zombie on the nack path)
+            if rec.req is not None:
+                self._phase(rec.req, "admit")
+            try:
+                kp, vp = decode_pages(rec.payload)
+            except MigrationError as e:
+                # detected, never admitted: the prefill slice re-encodes
+                if rec.req is not None:
+                    self._phase(rec.req, "migrate")  # back in flight
+                self.admit_from.nack(rec.seq)
+                self.obs.inc("serve_migrations_rejected",
+                             site=type(e).__name__)
+                continue
+            req = rec.req
+            if req is None:
+                req = Request(
+                    prompt=list(rec.prompt),
+                    max_new_tokens=rec.meta["max_new_tokens"],
+                    priority=rec.meta["priority"],
+                    temperature=rec.meta["temperature"],
+                    seed=rec.meta["seed"], eos_id=rec.meta["eos_id"])
+                req.request_id = rec.request_id  # keep the origin id
+                req.t_submit = time.perf_counter_ns()
+                self.requests.append(req)
+                self._begin_phase(req, "admit")
+            try:
+                self.pool.install(slot, kp, vp, rec.n_tokens)
+            except PoolExhausted:
+                self._pending_migrations.appendleft(rec)
+                return
+            req.slot = slot
+            req.pos = rec.n_tokens
+            req.state = RequestState.DECODE
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self.active[slot] = req
+            self.obs.inc("serve_admitted")
+            self.obs.inc("serve_migrations_in")
+            self._phase(req, "decode")
+            # the traveling first token: emitted HERE, single producer
+            self._emit(req, int(rec.first_token))
+            self.admit_from.ack(rec.seq)
+            self._admitted_migrations.add(rec.seq)
+
     def _admit(self) -> None:
+        self._admit_migrated()
         while len(self.active) < self.max_active:
             req = self.queue.peek()
             if req is None:
@@ -1343,6 +1596,8 @@ class Scheduler:
         # live form of the request ledger's phase columns
         for phase, name in (("queued", "serve_req_queued_us"),
                             ("prefill", "serve_req_prefill_us"),
+                            ("migrate", "serve_req_migrate_us"),
+                            ("admit", "serve_req_admit_us"),
                             ("decode", "serve_req_decode_us")):
             ns = req.phase_ns.get(phase)
             if ns is not None:

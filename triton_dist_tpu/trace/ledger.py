@@ -47,8 +47,13 @@ from typing import Dict, List
 
 LEDGER_MAGIC = "tdt-req-ledger"
 
-# phases whose accumulated spans must close against wall time
-_PHASES = ("queued", "prefill", "decode")
+# phases whose accumulated spans must close against wall time.
+# migrate/admit are the disaggregated prefill/decode legs (ISSUE 18,
+# xslice/): 0 on a single-slice scheduler, and on the in-process
+# DisaggPair the passenger Request accumulates all five across both
+# schedulers, so the prefill-side ledger closes the full TTFT
+# decomposition — prefill-slice time + migration + decode admission.
+_PHASES = ("queued", "prefill", "migrate", "admit", "decode")
 
 
 def _us(ns: int) -> float:
@@ -86,6 +91,8 @@ def build_ledger(sch, tol: float = 0.05) -> dict:
             "queued_us": _us(phases.get("queued", 0)),
             "inject_wait_us": _us(req.inject_wait_ns),
             "prefill_us": _us(phases.get("prefill", 0)),
+            "migrate_us": _us(phases.get("migrate", 0)),
+            "admit_us": _us(phases.get("admit", 0)),
             "decode_us": _us(phases.get("decode", 0)),
             # spec_verify is a SUB-BUCKET of decode (ISSUE 14): the
             # wall share of decode steps that ran a verify row. It is
@@ -219,7 +226,8 @@ def format_requests_table(ledger: dict) -> str:
     """The per-request table `scripts/trace_report.py --requests`
     prints: one row per request, decomposition columns in ms."""
     cols = (f"{'req':>5} {'state':<10} {'wall_ms':>9} {'queued':>8} "
-            f"{'inject':>8} {'prefill':>8} {'decode':>9} {'close':>6} "
+            f"{'inject':>8} {'prefill':>8} {'migrate':>8} "
+            f"{'admit':>8} {'decode':>9} {'close':>6} "
             f"{'ttft_ms':>8} {'tok':>4} {'steps':>6} {'win':>4} "
             f"{'dev_ms':>8}")
     lines = [cols]
@@ -233,7 +241,10 @@ def format_requests_table(ledger: dict) -> str:
             f"{row['request_id']:>5} {row['state']:<10} "
             f"{ms(row.get('wall_us')):>9} {ms(row['queued_us']):>8} "
             f"{ms(row.get('inject_wait_us', 0)):>8} "
-            f"{ms(row['prefill_us']):>8} {ms(row['decode_us']):>9} "
+            f"{ms(row['prefill_us']):>8} "
+            f"{ms(row.get('migrate_us', 0)):>8} "
+            f"{ms(row.get('admit_us', 0)):>8} "
+            f"{ms(row['decode_us']):>9} "
             f"{'-' if close is None else format(close, '.3f'):>6} "
             f"{ms(row.get('ttft_us')):>8} {row.get('tokens_out', 0):>4} "
             f"{row['device_steps']:>6} {row.get('windows', 0):>4} "

@@ -38,6 +38,7 @@ _PROTOCOL_MODULES = (
     "triton_dist_tpu.kernels.low_latency_allgather",
     "triton_dist_tpu.kernels.flash_prefill",
     "triton_dist_tpu.kernels.p2p",
+    "triton_dist_tpu.xslice.collectives",
 )
 
 

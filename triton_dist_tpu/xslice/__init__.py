@@ -1,0 +1,48 @@
+"""triton_dist_tpu.xslice — scale beyond one slice.
+
+Two planes:
+
+  collectives   2-level ICI+DCN allgather / reduce-scatter / allreduce
+                (slice-scoped Pallas rings + a wire-codable XLA DCN
+                hop, chunk-overlapped), with verifier protocol models
+                concretized at hierarchical (slices, n_local) grids;
+  serving       disaggregated prefill/decode — a prefill slice streams
+                finished KV pages to decode slices as checksummed
+                `wire.WireFormat` images (`migrate`), and
+                `serve.Scheduler` grows slice roles (`disagg`).
+
+`topo.SliceTeam` is the shared rank factorization under both.
+"""
+
+from triton_dist_tpu.xslice.topo import (  # noqa: F401
+    DCN_AXIS,
+    SliceTeam,
+    make_xslice_mesh,
+)
+from triton_dist_tpu.xslice.collectives import (  # noqa: F401
+    hier_all_gather,
+    hier_all_gather_op,
+    hier_all_reduce,
+    hier_all_reduce_op,
+    hier_reduce_scatter,
+    hier_reduce_scatter_op,
+)
+from triton_dist_tpu.xslice.migrate import (  # noqa: F401
+    FileMigrationChannel,
+    MigrationChannel,
+    MigrationError,
+    MigrationRecord,
+    decode_pages,
+    encode_pages,
+)
+from triton_dist_tpu.xslice.disagg import DisaggPair  # noqa: F401
+
+__all__ = [
+    "DCN_AXIS", "SliceTeam", "make_xslice_mesh",
+    "hier_all_gather", "hier_reduce_scatter", "hier_all_reduce",
+    "hier_all_gather_op", "hier_reduce_scatter_op",
+    "hier_all_reduce_op",
+    "MigrationRecord", "MigrationChannel", "FileMigrationChannel",
+    "MigrationError", "encode_pages", "decode_pages",
+    "DisaggPair",
+]

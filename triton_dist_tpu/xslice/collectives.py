@@ -1,0 +1,344 @@
+"""2-level ICI + DCN collectives (hierarchical allgather /
+reduce-scatter / allreduce).
+
+The reference's inter-node family (2D-ring inter-node AG, inter-node
+RS — SURVEY VERDICT missing #2) crosses TWO transports with a ~30x
+bandwidth cliff between them. The TPU-native analog keeps each
+transport on its natural plane:
+
+  ICI leg    the existing Pallas ring protocols (kernels/allgather.py,
+             kernels/reduce_scatter.py), run PER SLICE over the "tp"
+             axis of a ("dcn", "tp") mesh — slice-local rings never
+             cross the cliff;
+  DCN leg    an XLA collective between the slices ("dcn" axis): every
+             rank exchanges with its RAIL (the same local rank in
+             every slice — rails are disjoint, so no leader funnel
+             serializes the hop), and `wire_format=` applies HERE,
+             where the EQuARX economics (arXiv 2506.17615) pay most —
+             the image is packed once at the send edge and decoded at
+             the consume edge in fixed slice order, so chunked and
+             unchunked runs reduce in the same order (bitwise).
+
+Overlap: `chunks > 1` splits the payload along its last axis and
+issues the ICI leg of chunk i+1 BEFORE the DCN leg of chunk i (T3's
+compute-triggered communication idiom, arXiv 2401.16677, applied
+across the transport cliff) — the legs carry no data dependency, so
+XLA is free to run the slice rings under the slow DCN exchange.
+Chunked output is BITWISE the unchunked staged composition
+(tests/test_xslice.py pins it), so the overlap knob is free to turn.
+
+Protocol models: the same slice-scoped skeletons (`space=` on
+`_ag_protocol` / `_rs_protocol`) composed with the rail-exchange model
+below register as `xslice_allgather` / `xslice_reduce_scatter` /
+`xslice_allreduce`, concretized by the verifier at every global rank
+of (slices=2, n_local=2/4) grids, wire grids skeleton-invariant
+(verify.check_format_invariance).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather import (
+    AllGatherMethod,
+    all_gather,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_dist_tpu.lang import shmem
+from triton_dist_tpu.runtime.init import TP_AXIS
+from triton_dist_tpu.wire import codec as wcodec
+from triton_dist_tpu.xslice.topo import DCN_AXIS, SliceTeam
+
+__all__ = [
+    "hier_all_gather", "hier_reduce_scatter", "hier_all_reduce",
+    "hier_all_gather_op", "hier_reduce_scatter_op",
+    "hier_all_reduce_op",
+]
+
+
+# -- chunked overlap ----------------------------------------------------------
+
+
+def _split(x, chunks: int):
+    """Split along the last axis for the overlap pipeline; a payload
+    the chunk count does not divide runs unchunked (correctness never
+    depends on the split)."""
+    if chunks <= 1 or x.shape[-1] % chunks != 0:
+        return [x]
+    return jnp.split(x, chunks, axis=-1)
+
+
+def _pipelined(pieces, ici_fn, dcn_fn):
+    """Issue order: ICI(i+1) before DCN(i). The ICI ring of the next
+    chunk carries no dependency on the previous chunk's DCN exchange,
+    so the slice rings overlap the slow hop; the per-chunk results
+    concat back in order."""
+    outs, prev = [], None
+    for p in pieces:
+        cur = ici_fn(p)
+        if prev is not None:
+            outs.append(dcn_fn(prev))
+        prev = cur
+    outs.append(dcn_fn(prev))
+    return outs
+
+
+def _dcn_sum(part, dcn_axis: str, slices: int, fmt):
+    """Sum `part` across slices. Native: lax.psum (XLA owns the DCN
+    trees). Wire: pack once at the send edge, gather the images, and
+    decode-accumulate in FIXED slice order — deterministic, so the
+    chunked pipeline reduces bitwise like the unchunked run."""
+    if wcodec.is_native(fmt):
+        return jax.lax.psum(part, dcn_axis)
+    w = wcodec.pack(part, fmt)
+    g = jax.lax.all_gather(w, dcn_axis)          # (slices, rows_w, cw)
+    acc = wcodec.unpack(g[0], part.shape[1:], fmt, jnp.float32)
+    for j in range(1, slices):
+        acc = acc + wcodec.unpack(g[j], part.shape[1:], fmt,
+                                  jnp.float32)
+    return acc.astype(part.dtype)
+
+
+def _dcn_gather(blk, dcn_axis: str, slices: int, fmt):
+    """Concatenate the slice blocks across the DCN axis, slice order
+    (dcn-major — matches SliceTeam.globalize). Wire: the image crosses
+    the hop; each slot decodes at the consume edge."""
+    if wcodec.is_native(fmt):
+        return jax.lax.all_gather(blk, dcn_axis, tiled=True)
+    w = wcodec.pack(blk, fmt)
+    g = jax.lax.all_gather(w, dcn_axis)
+    return jnp.concatenate(
+        [wcodec.unpack(g[j], blk.shape[1:], fmt, blk.dtype)
+         for j in range(slices)], axis=0)
+
+
+# -- per-device 2-level collectives -------------------------------------------
+
+
+def hier_all_gather(x, dcn_axis: str = DCN_AXIS, ici_axis: str = TP_AXIS,
+                    wire_format=None, chunks: int = 1,
+                    ici_method: AllGatherMethod = AllGatherMethod.Auto):
+    """Hierarchical AG, per-device: shard (m, ...) -> (N*m, ...) with
+    shards in global-rank order (dcn-major). Phase 1 gathers the slice
+    block over the ICI ring; phase 2 moves whole slice blocks across
+    the DCN hop (`wire_format` applies to this leg only — the ICI leg
+    stays native)."""
+    fmt = wcodec.resolve(wire_format)
+    slices = jax.lax.axis_size(dcn_axis)
+
+    def ici(piece):
+        return all_gather(piece, ici_axis, method=ici_method)
+
+    def dcn(blk):
+        return _dcn_gather(blk, dcn_axis, slices, fmt)
+
+    outs = _pipelined(_split(x, chunks), ici, dcn)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+def hier_reduce_scatter(x, dcn_axis: str = DCN_AXIS,
+                        ici_axis: str = TP_AXIS, wire_format=None,
+                        chunks: int = 1,
+                        ici_method=ReduceScatterMethod.Auto):
+    """Hierarchical RS, per-device: (N*m, ...) -> (m, ...). Phase 1
+    reduce-scatters over the ICI ring (rank local i holds super-chunk i
+    summed over its slice); phase 2 completes the sum across slices and
+    scatters super-chunk i's `slices` sub-chunks down the rail. The
+    OUTPUT CHUNK INDEX is therefore `local * slices + sid` (ICI-major)
+    — the staged-composition order, pinned by tests/test_xslice.py.
+    `wire_format` rides the DCN leg: the slice-partial crosses as a
+    packed image and the cross-slice sum runs decode-accumulate in
+    fixed slice order."""
+    fmt = wcodec.resolve(wire_format)
+    slices = jax.lax.axis_size(dcn_axis)
+    sid = jax.lax.axis_index(dcn_axis)
+
+    def ici(piece):
+        return reduce_scatter(piece, ici_axis, method=ici_method)
+
+    def dcn(part):
+        if wcodec.is_native(fmt):
+            return jax.lax.psum_scatter(part, dcn_axis, tiled=True)
+        full = _dcn_sum(part, dcn_axis, slices, fmt)
+        m = full.shape[0] // slices
+        return jax.lax.dynamic_slice_in_dim(full, sid * m, m, axis=0)
+
+    outs = _pipelined(_split(x, chunks), ici, dcn)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+def hier_all_reduce(x, dcn_axis: str = DCN_AXIS,
+                    ici_axis: str = TP_AXIS, wire_format=None,
+                    chunks: int = 1):
+    """Two-level AR, per-device: (R, ...) -> (R, ...) summed over the
+    whole team. RS over the ICI ring, AR across the DCN hop (wire
+    image + fixed-order decode-sum when quantized), AG back over the
+    ICI ring — the two-shot composition with the slow hop pinched to
+    1/n_local of the payload."""
+    fmt = wcodec.resolve(wire_format)
+    slices = jax.lax.axis_size(dcn_axis)
+
+    def ici(piece):
+        return reduce_scatter(piece, ici_axis)
+
+    def dcn_then_ag(part):
+        summed = _dcn_sum(part, dcn_axis, slices, fmt)
+        return all_gather(summed, ici_axis)
+
+    outs = _pipelined(_split(x, chunks), ici, dcn_then_ag)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+# -- host entries -------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _hier_jit(mesh, collective: str, dcn_axis: str, ici_axis: str, fmt,
+              chunks: int):
+    if collective == "allgather":
+        def fn(xs):
+            return hier_all_gather(xs, dcn_axis, ici_axis,
+                                   wire_format=fmt, chunks=chunks)
+        in_specs, out_specs = P((dcn_axis, ici_axis)), P()
+    elif collective == "reduce_scatter":
+        def fn(xs):
+            return hier_reduce_scatter(xs[0], dcn_axis, ici_axis,
+                                       wire_format=fmt, chunks=chunks)
+        in_specs = P((dcn_axis, ici_axis))
+        out_specs = P((dcn_axis, ici_axis))
+    elif collective == "allreduce":
+        def fn(xs):
+            return hier_all_reduce(xs[0], dcn_axis, ici_axis,
+                                   wire_format=fmt, chunks=chunks)
+        in_specs, out_specs = P((dcn_axis, ici_axis)), P()
+    else:
+        raise ValueError(f"unknown hierarchical collective "
+                         f"{collective!r}")
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def hier_all_gather_op(arr, mesh, dcn_axis: str = DCN_AXIS,
+                       ici_axis: str = TP_AXIS, wire_format=None,
+                       chunks: int = 1):
+    """Host-level hierarchical AG: `arr` sharded on dim 0 across
+    (dcn, tp); returns the full gather in global-rank order."""
+    return _hier_jit(mesh, "allgather", dcn_axis, ici_axis,
+                     wcodec.resolve(wire_format), chunks)(arr)
+
+
+def hier_reduce_scatter_op(arr, mesh, dcn_axis: str = DCN_AXIS,
+                           ici_axis: str = TP_AXIS, wire_format=None,
+                           chunks: int = 1):
+    """Host-level hierarchical RS: `arr` is (N, R, ...) — row g is
+    rank g's full contribution; returns the per-rank chunks stacked in
+    global-rank order (rank g's chunk is output chunk
+    `local(g) * slices + sid(g)` of the summed array — see
+    hier_reduce_scatter)."""
+    return _hier_jit(mesh, "reduce_scatter", dcn_axis, ici_axis,
+                     wcodec.resolve(wire_format), chunks)(arr)
+
+
+def hier_all_reduce_op(arr, mesh, dcn_axis: str = DCN_AXIS,
+                       ici_axis: str = TP_AXIS, wire_format=None,
+                       chunks: int = 1):
+    """Host-level 2-level AR: `arr` is (N, R, ...) — row g is rank g's
+    contribution; returns the (R, ...) team sum."""
+    return _hier_jit(mesh, "allreduce", dcn_axis, ici_axis,
+                     wcodec.resolve(wire_format), chunks)(arr)
+
+
+# -- protocol models (static verifier, triton_dist_tpu.verify) ---------------
+
+from triton_dist_tpu import verify as _v  # noqa: E402
+from triton_dist_tpu.kernels.allgather import _ag_protocol  # noqa: E402
+from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: E402
+    _rs_protocol,
+)
+
+_XGRID = ({"slices": 2}, {"slices": 2, "fmt": "fp8"},
+          {"slices": 2, "fmt": "int8"})
+
+
+def _rail_exchange(team: SliceTeam, prefix="dcn.", fmt="native",
+                   srcs=()):
+    """The DCN-hop model: an all-to-all among each rank's rail (the
+    same local rank in every slice). Every member stages its block
+    (wire: the send-edge encode), puts it into each rail peer's inbox
+    slot KEYED BY THE SENDER'S SLICE ID, waits its own sends, then
+    consumes each arrival behind that sender's keyed recv slot — the
+    keying is what gives each delivery its own happens-before edge
+    (a shared slot would let slice j's wait be satisfied by slice k's
+    arrival: the race class the verifier flags). `fmt` only changes
+    the local stage dataflow, never the sem skeleton
+    (check_format_invariance covers the xslice grids)."""
+    me_g = shmem.my_pe(TP_AXIS)
+    sid = team.slice_of(me_g)
+    local = team.local_of(me_g)
+    blk = _v.ref(prefix + "blk")
+    inbox = _v.ref(prefix + "inbox")
+    send, recv = _v.sem(prefix + "send_sem"), _v.sem(prefix + "recv_sem")
+    for s in srcs:
+        _v.read(s)         # stage from the ICI leg's output
+    _v.write(blk.at())     # the staged block (wire: the packed image)
+    handles = []
+    for j in range(1, team.slices):
+        peer = ((sid + j) % team.slices) * team.n_local + local
+        handles.append(
+            shmem.putmem_nbi(inbox.at(sid), blk.at(), send.at(),
+                             recv.at(sid), peer, TP_AXIS))
+    for h in handles:
+        h.wait_send()
+    for j in range(1, team.slices):
+        src_sid = (sid + team.slices - j) % team.slices
+        shmem.signal_wait_until(recv.at(src_sid), shmem.CMP_GE, 1)
+        _v.read(inbox.at(src_sid))  # consume edge (wire: decode)
+    return inbox
+
+
+@_v.protocol("xslice_allgather", ns=(4, 8), grid=_XGRID,
+             doc="2-level AG: slice-scoped ring AG (space= on "
+                 "_ag_protocol) + DCN rail exchange of whole slice "
+                 "blocks; fmt != native packs the DCN leg only")
+def _xag_protocol(n, slices=2, fmt="native"):
+    team = SliceTeam(slices, n // slices)
+    _ag_protocol(team.n_local, method="ring", prefix="ici.", space=team)
+    out = _v.ref("ici.out")
+    _rail_exchange(team, prefix="dcn.", fmt=fmt,
+                   srcs=[out.at(j) for j in range(team.n_local)])
+
+
+@_v.protocol("xslice_reduce_scatter", ns=(4, 8), grid=_XGRID,
+             doc="2-level RS: slice-scoped credit-flow ring RS + DCN "
+                 "rail exchange of the slice-partial + fixed-order "
+                 "local sum; fmt != native packs the DCN leg only")
+def _xrs_protocol(n, slices=2, fmt="native"):
+    team = SliceTeam(slices, n // slices)
+    _rs_protocol(team.n_local, prefix="ici.", space=team)
+    _rail_exchange(team, prefix="dcn.", fmt=fmt,
+                   srcs=[_v.ref("ici.o").at()])
+    # the cross-slice reduction: own staged block + every arrival
+    # (arrivals were consumed behind their keyed recv waits above)
+    _v.read(_v.ref("dcn.blk").at())
+    _v.write(_v.ref("o").at())
+
+
+@_v.protocol("xslice_allreduce", ns=(4, 8), grid=_XGRID,
+             doc="2-level AR: slice RS + DCN rail allreduce + slice "
+                 "AG — the two-shot composition with the slow hop "
+                 "pinched to the slice-partial")
+def _xar_protocol(n, slices=2, fmt="native"):
+    team = SliceTeam(slices, n // slices)
+    _rs_protocol(team.n_local, prefix="rs.", space=team)
+    _rail_exchange(team, prefix="dcn.", fmt=fmt,
+                   srcs=[_v.ref("rs.o").at()])
+    _v.read(_v.ref("dcn.blk").at())
+    _v.write(_v.ref("ar").at())
+    _ag_protocol(team.n_local, method="ring", prefix="ag.", space=team)

@@ -1,0 +1,85 @@
+"""DisaggPair — a prefill slice and a decode slice wired together.
+
+The in-process harness for disaggregated prefill/decode serving: two
+`serve.Scheduler`s (role="prefill" / role="decode") joined by a
+migration channel, pumped in lockstep. Each scheduler owns its own
+KVPool (disjoint device state — nothing is shared but the channel), so
+the pair exercises the REAL migration path: pages leave the prefill
+pool as a checksummed wire image and enter the decode pool through
+verified admission, with the first token traveling in the record.
+
+The acceptance oracle (tests/test_xslice.py, tier-1): for the same
+submissions, the pair's per-request token streams are BITWISE what a
+single `role="both"` scheduler over the same engine emits — greedy and
+sampled (the sampling key is derived from (seed, output index), worker
+`key_for`, so it survives the hop by construction).
+
+TTFT decomposition: the migrated Request object is the channel's
+passenger, so its phase ledger accumulates across both schedulers —
+queued/prefill on the prefill slice, migrate (send -> pulled off the
+channel), admit (verify + install), decode on the decode slice — and
+the prefill-side `ledger()` closes the full wall
+(trace/ledger.py's contract, now over five phases).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from triton_dist_tpu.xslice.migrate import MigrationChannel
+
+__all__ = ["DisaggPair"]
+
+
+class DisaggPair:
+    """Prefill + decode schedulers over a migration channel.
+
+    `engine` serves both sides by default (the CPU rig: two pools,
+    one model); pass `decode_engine` for genuinely separate slices.
+    Extra scheduler kwargs go through `prefill_kw` / `decode_kw`.
+    """
+
+    def __init__(self, engine, decode_engine=None, channel=None,
+                 migration_format=None, prefill_kw: Optional[dict] = None,
+                 decode_kw: Optional[dict] = None):
+        from triton_dist_tpu.serve.scheduler import Scheduler
+
+        self.channel = channel if channel is not None \
+            else MigrationChannel()
+        self.prefill = Scheduler(
+            engine, role="prefill", migrate_to=self.channel,
+            migration_format=migration_format, **(prefill_kw or {}))
+        self.decode = Scheduler(
+            decode_engine if decode_engine is not None else engine,
+            role="decode", admit_from=self.channel,
+            **(decode_kw or {}))
+
+    def submit(self, *args, **kwargs):
+        return self.prefill.submit(*args, **kwargs)
+
+    def step(self) -> bool:
+        """One lockstep round: the prefill slice first (it feeds the
+        channel), then the decode slice (it drains it)."""
+        a = self.prefill.step()
+        b = self.decode.step()
+        return a or b
+
+    def run(self, max_steps: int = 100_000) -> None:
+        """Pump both sides until queues, slots, and the channel drain."""
+        for _ in range(max_steps):
+            busy = self.step()
+            if (not busy and self.prefill.queue.peek() is None
+                    and not self.prefill._migrating
+                    and not self.decode._pending_migrations):
+                return
+        raise RuntimeError(
+            f"disaggregated pair did not drain in {max_steps} steps")
+
+    def metrics(self) -> dict:
+        """Both sides' metrics, plus the channel's fault counters."""
+        out = {"prefill": self.prefill.metrics(),
+               "decode": self.decode.metrics()}
+        for key in ("n_sent", "n_dropped", "n_corrupted", "n_acked",
+                    "n_nacked"):
+            out["channel_" + key] = getattr(self.channel, key, 0)
+        return out

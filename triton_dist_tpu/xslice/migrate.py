@@ -1,0 +1,321 @@
+"""Wire-coded KV page migration between slices.
+
+The disaggregated serving plane (docs/serving.md "Disaggregated
+prefill/decode") moves a finished prefill's KV pages from the prefill
+slice to a decode slice. The pages cross the DCN hop as
+`wire.WireFormat` images — EQuARX economics (arXiv 2506.17615) bite
+hardest on the slow transport, and `perf_model.choose_migration_format`
+prices the shrink against an error budget — wrapped in a frame-level
+crc32 envelope, so EVERY migration (native included — the codec itself
+refuses `WireFormat("native", checksum=True)`) is integrity-gated at
+the destination: admission happens only after `decode_pages` verifies
+the envelope (and, for quantized images, the codec's own per-block
+checksums via `unpack_checked`). A corrupted or truncated image raises
+`MigrationError` — the decode slice NACKs and the prefill slice
+re-encodes from its still-held pages; silent-wrong is structurally
+unreachable.
+
+Fidelity contract: a native image round-trips bitwise; an fp8/int8
+image reproduces EXACTLY `wire.codec.roundtrip(x, fmt)` — the codec's
+documented quantization, nothing more (tests/test_xslice.py pins both).
+
+Transports:
+
+  MigrationChannel       in-process deque pair — the DisaggPair /
+                         chaos-cell rig. Chaos knobs (`drop_next`,
+                         `corrupt_next`, and their `_all` persistent
+                         forms) inject exactly the DCN faults the
+                         `faults/` matrix classifies.
+  FileMigrationChannel   a real cross-process transport over a shared
+                         directory (atomic tmp+rename publication) —
+                         what the 2-process DCN test in
+                         tests/test_xslice.py runs the disaggregated
+                         pair over.
+
+The sender HOLDS its pool pages until the ack for a seq arrives —
+resend/re-encode needs the source of truth — and every record carries
+enough (`prompt`, `meta`, `first_token`) to rebuild the request on a
+decode slice that shares no memory with the prefill slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from collections import deque
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.faults.errors import WireIntegrityError
+from triton_dist_tpu.wire import codec as wcodec
+
+__all__ = [
+    "MigrationError", "MigrationRecord", "MigrationChannel",
+    "FileMigrationChannel", "encode_pages", "decode_pages",
+]
+
+
+class MigrationError(RuntimeError):
+    """A migration image failed integrity verification (frame crc32 or
+    codec per-block checksum) — the caller NACKs, never admits."""
+
+
+def _crc(b: np.ndarray) -> int:
+    return zlib.crc32(b.tobytes()) & 0xFFFFFFFF
+
+
+def _to_bytes(img: np.ndarray) -> np.ndarray:
+    return np.frombuffer(np.ascontiguousarray(img).tobytes(),
+                         np.uint8).copy()
+
+
+def encode_pages(k_pages, v_pages, wire_format=None) -> dict:
+    """Encode a KV page stack pair ((L, Hkv, P, page, D) each, the
+    `KVPool.export_pages` layout) into a checksummed migration payload.
+    Quantized formats pack the DCN image through the wire codec; native
+    ships raw bytes. Both get the frame crc32 envelope."""
+    fmt = wcodec.resolve(wire_format)
+    payload: dict = {
+        "fmt": (fmt.kind, fmt.block, bool(fmt.checksum)),
+        "shape": tuple(int(s) for s in k_pages.shape),
+        "dtype": str(np.asarray(k_pages).dtype),
+    }
+    for name, a in (("k", k_pages), ("v", v_pages)):
+        a = np.asarray(a)
+        assert tuple(a.shape) == payload["shape"], (a.shape,
+                                                    payload["shape"])
+        if wcodec.is_native(fmt):
+            img = np.ascontiguousarray(a)
+        else:
+            x2d = jnp.asarray(a).reshape(-1, a.shape[-1])
+            img = np.asarray(wcodec.pack(x2d, fmt))
+        payload[name + "_bytes"] = _to_bytes(img)
+        payload[name + "_crc"] = _crc(payload[name + "_bytes"])
+        payload[name + "_img_shape"] = tuple(int(s) for s in img.shape)
+        payload[name + "_img_dtype"] = str(img.dtype)
+    return payload
+
+
+def payload_nbytes(payload: dict) -> int:
+    return int(payload["k_bytes"].size + payload["v_bytes"].size)
+
+
+def _decode_one(payload: dict, name: str, fmt):
+    b = payload[name + "_bytes"]
+    if _crc(b) != payload[name + "_crc"]:
+        raise MigrationError(
+            f"migration frame crc mismatch on {name!r} image")
+    shape = tuple(payload["shape"])
+    dt = jnp.dtype(payload["dtype"])
+    img_dt = jnp.dtype(payload[name + "_img_dtype"])
+    try:
+        img = np.frombuffer(b.tobytes(), img_dt).reshape(
+            payload[name + "_img_shape"])
+    except ValueError as e:
+        raise MigrationError(f"truncated {name!r} image: {e}") from e
+    if wcodec.is_native(fmt):
+        return img.reshape(shape)
+    trailing = shape[-1:]
+    unpack = wcodec.unpack_checked if fmt.checksum else wcodec.unpack
+    try:
+        x2d = unpack(jnp.asarray(img), trailing, fmt, dt)
+    except WireIntegrityError as e:
+        raise MigrationError(
+            f"wire checksum failed on {name!r} image: {e}") from e
+    return np.asarray(x2d).reshape(shape)
+
+
+def decode_pages(payload: dict) -> Tuple[np.ndarray, np.ndarray]:
+    """Verify and decode a migration payload back to the
+    (L, Hkv, P, page, D) page-stack pair. Raises MigrationError on any
+    integrity failure — admission must gate on this call succeeding."""
+    kind, block, checksum = payload["fmt"]
+    fmt = wcodec.WireFormat(kind=str(kind),
+                            block=None if block is None else int(block),
+                            checksum=bool(checksum))
+    return (_decode_one(payload, "k", fmt),
+            _decode_one(payload, "v", fmt))
+
+
+@dataclasses.dataclass
+class MigrationRecord:
+    """One in-flight prefill→decode handoff.
+
+    `payload` is the checksummed KV image (encode_pages); `first_token`
+    is the token the prefill step emitted — it TRAVELS rather than
+    being emitted on the prefill slice, so the token stream has a
+    single producer (the decode slice) and bit-identity with the
+    single-slice scheduler is checkable end-to-end. `req` is an
+    in-process passenger only (DisaggPair keeps the live Request so
+    streams/callbacks survive the hop); cross-process transports strip
+    it and the decode slice rebuilds from `prompt` + `meta`.
+    """
+
+    seq: int
+    request_id: int
+    prompt: Tuple[int, ...]
+    n_tokens: int
+    first_token: int
+    payload: dict
+    meta: dict
+    req: object = None
+
+    def strip(self) -> "MigrationRecord":
+        return dataclasses.replace(self, req=None)
+
+
+def _corrupt_record(rec: MigrationRecord) -> MigrationRecord:
+    """Bit-flip the first byte of the k image (payload copied — the
+    sender's copy stays pristine for the re-encode/resend path)."""
+    payload = dict(rec.payload)
+    b = payload["k_bytes"].copy()
+    b[0] ^= 0xFF
+    payload["k_bytes"] = b
+    return dataclasses.replace(rec, payload=payload)
+
+
+class MigrationChannel:
+    """In-process migration transport (deque pair) with DCN chaos
+    knobs. `send` consumes one-shot knobs first, then persistent ones;
+    a dropped record simply never arrives (the sender's unacked-resend
+    loop is what recovers), a corrupted record arrives and FAILS
+    decode_pages on the far side (the nack path)."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._acks: deque = deque()
+        self.drop_next = 0
+        self.drop_all = False
+        self.corrupt_next = 0
+        self.corrupt_all = False
+        self.n_sent = 0
+        self.n_dropped = 0
+        self.n_corrupted = 0
+        self.n_acked = 0
+        self.n_nacked = 0
+
+    def send(self, rec: MigrationRecord) -> None:
+        self.n_sent += 1
+        if self.drop_next > 0 or self.drop_all:
+            if self.drop_next > 0:
+                self.drop_next -= 1
+            self.n_dropped += 1
+            return
+        if self.corrupt_next > 0 or self.corrupt_all:
+            if self.corrupt_next > 0:
+                self.corrupt_next -= 1
+            rec = _corrupt_record(rec)
+            self.n_corrupted += 1
+        self._q.append(rec)
+
+    def recv(self) -> Optional[MigrationRecord]:
+        return self._q.popleft() if self._q else None
+
+    def ack(self, seq: int) -> None:
+        self.n_acked += 1
+        self._acks.append(("ack", seq))
+
+    def nack(self, seq: int) -> None:
+        self.n_nacked += 1
+        self._acks.append(("nack", seq))
+
+    def pump_acks(self) -> List[Tuple[str, int]]:
+        out = list(self._acks)
+        self._acks.clear()
+        return out
+
+
+class FileMigrationChannel:
+    """Cross-process migration transport over a shared directory.
+
+    Records publish as `rec_<seq>_<n>.npz` via atomic tmp+rename (a
+    reader can never observe a partial file); acks/nacks publish the
+    same way as empty `ack_<seq>.ok` / `nack_<seq>.ok` markers. A
+    resend of seq publishes under a bumped attempt counter `<n>` so it
+    is a NEW file (the consumer tracks consumed (seq, n) pairs and
+    decodes the freshest unconsumed attempt). This is the transport the
+    2-process DCN test runs the disaggregated pair over — two
+    schedulers in different OS processes, no shared memory.
+    """
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._consumed: set = set()
+        self._seen_acks: set = set()
+        self._attempt: Dict[int, int] = {}
+        self.n_sent = 0
+        self.n_acked = 0
+        self.n_nacked = 0
+
+    def _publish(self, name: str, writer) -> None:
+        tmp = self.root / ("." + name + ".tmp")
+        writer(tmp)
+        os.replace(tmp, self.root / name)
+
+    def send(self, rec: MigrationRecord) -> None:
+        rec = rec.strip()
+        n = self._attempt.get(rec.seq, 0)
+        self._attempt[rec.seq] = n + 1
+        hdr = {
+            "seq": rec.seq, "request_id": rec.request_id,
+            "prompt": list(rec.prompt), "n_tokens": rec.n_tokens,
+            "first_token": rec.first_token, "meta": rec.meta,
+            "payload": {k: (list(v) if isinstance(v, tuple) else v)
+                        for k, v in rec.payload.items()
+                        if not k.endswith("_bytes")},
+        }
+
+        def write(tmp: Path) -> None:
+            with open(tmp, "wb") as f:
+                np.savez(f, header=np.asarray(json.dumps(hdr)),
+                         k_bytes=rec.payload["k_bytes"],
+                         v_bytes=rec.payload["v_bytes"])
+
+        self._publish(f"rec_{rec.seq:08d}_{n:04d}.npz", write)
+        self.n_sent += 1
+
+    def recv(self) -> Optional[MigrationRecord]:
+        for p in sorted(self.root.glob("rec_*.npz")):
+            seq, n = (int(x) for x in p.stem.split("_")[1:3])
+            if (seq, n) in self._consumed:
+                continue
+            self._consumed.add((seq, n))
+            with np.load(p) as z:
+                hdr = json.loads(str(z["header"]))
+                payload = {k: (tuple(v) if isinstance(v, list) else v)
+                           for k, v in hdr["payload"].items()}
+                payload["fmt"] = tuple(payload["fmt"])
+                payload["k_bytes"] = z["k_bytes"]
+                payload["v_bytes"] = z["v_bytes"]
+            return MigrationRecord(
+                seq=seq, request_id=hdr["request_id"],
+                prompt=tuple(hdr["prompt"]), n_tokens=hdr["n_tokens"],
+                first_token=hdr["first_token"], payload=payload,
+                meta=hdr["meta"], req=None)
+        return None
+
+    def ack(self, seq: int) -> None:
+        self._publish(f"ack_{seq:08d}.ok",
+                      lambda tmp: tmp.write_bytes(b""))
+        self.n_acked += 1
+
+    def nack(self, seq: int) -> None:
+        self._publish(f"nack_{seq:08d}.ok",
+                      lambda tmp: tmp.write_bytes(b""))
+        self.n_nacked += 1
+
+    def pump_acks(self) -> List[Tuple[str, int]]:
+        out = []
+        for p in sorted(self.root.glob("*.ok")):
+            if p.name in self._seen_acks:
+                continue
+            self._seen_acks.add(p.name)
+            verb = "ack" if p.name.startswith("ack_") else "nack"
+            out.append((verb, int(p.stem.split("_")[1])))
+        return out

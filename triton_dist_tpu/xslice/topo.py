@@ -1,0 +1,124 @@
+"""Hierarchical rank spaces for 2-level (ICI + DCN) collectives.
+
+A multi-slice deployment factorizes the global team of N ranks into
+`slices` pods of `n_local` chips each: global rank
+
+    g = sid * n_local + local        (DCN-major)
+
+where `local` addresses a chip inside its slice (the fast ICI domain)
+and `sid` addresses the slice (the slow DCN domain). `SliceTeam` is
+that factorization as an object — usable both with concrete ints (host
+scheduling, tests) and with the verifier's symbolic rank `me`
+(`verify.capture.Sym` supports exactly the `% // * + -` arithmetic the
+split needs), which is what lets the SAME ring protocol models in
+`kernels/allgather.py` / `kernels/reduce_scatter.py` re-run scoped to
+a slice: the `space=` parameter rebases every ring peer from
+`(me ± s) % n` to `base + (local ± s) % n_local`, and the verifier
+concretizes the composed 2-level protocol at every global rank of an
+(slices, n_local) grid (tests/test_xslice.py).
+
+`make_xslice_mesh` builds the matching jax mesh over ("dcn", "tp")
+axes by splitting a flat device list DCN-major (`runtime.split_mesh`),
+so `jax.lax.axis_index("dcn") == sid` and `axis_index("tp") == local`
+inside a 2-axis shard_map — the mesh the hierarchical collectives in
+`xslice/collectives.py` run on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+DCN_AXIS = "dcn"
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTeam:
+    """The slice-id / local-rank factorization of a global team.
+
+    All rank arithmetic works on ints AND on the verifier's symbolic
+    `me` (verify.capture.Sym). Methods that need a concrete enumeration
+    (`rail`, `leaders`) take/return ints only.
+    """
+
+    slices: int
+    n_local: int
+
+    def __post_init__(self):
+        assert self.slices >= 1 and self.n_local >= 1, (self.slices,
+                                                        self.n_local)
+
+    @property
+    def n(self) -> int:
+        return self.slices * self.n_local
+
+    # -- rank arithmetic (int or Sym) -----------------------------------
+
+    def slice_of(self, g):
+        return g // self.n_local
+
+    def local_of(self, g):
+        return g % self.n_local
+
+    def globalize(self, sid, local):
+        return sid * self.n_local + local
+
+    def split(self, g):
+        """(base, local): `base` is the slice's first global rank, so a
+        slice-scoped ring peer `(local ± s) % n_local` globalizes as
+        `base + peer`. Works symbolically (base = g - g % n_local)."""
+        local = g % self.n_local
+        return g - local, local
+
+    # -- concrete-only helpers ------------------------------------------
+
+    def leader_of(self, sid: int) -> int:
+        return sid * self.n_local
+
+    def leaders(self):
+        """The slice leaders (local rank 0 of every slice) — the ranks
+        that terminate a leader-hop DCN exchange."""
+        return [self.leader_of(s) for s in range(self.slices)]
+
+    def rail(self, g: int):
+        """The DCN rail through global rank g: the same local rank in
+        every slice (the peers of the per-rank DCN exchange — every
+        rail is disjoint, so the rail all-to-all needs no leader
+        funnel)."""
+        local = int(g) % self.n_local
+        return [s * self.n_local + local for s in range(self.slices)]
+
+    # -- verifier-side slice barrier ------------------------------------
+
+    def neighbor_barrier(self, prefix: str, local, base, n_local: int):
+        """Slice-scoped ring-neighbor barrier for protocol MODELS
+        (capture-time): `shmem.neighbor_barrier` hard-codes the global
+        ring `(me ± 1) % n`, so slice rings record their exact sem
+        decomposition here instead — two signals to the slice-local
+        ring neighbors (globalized through `base`) plus one consuming
+        wait for both, the same decomposition neighbor_barrier itself
+        records."""
+        from triton_dist_tpu.lang import shmem
+        from triton_dist_tpu.runtime.init import TP_AXIS
+        from triton_dist_tpu import verify as _v
+
+        bsem = _v.sem(prefix + "__slice_nbar__")
+        for d in ((local - 1 + n_local) % n_local,
+                  (local + 1) % n_local):
+            shmem.signal(bsem.at(), 1, shmem.SIGNAL_ADD, base + d,
+                         TP_AXIS, label="barrier")
+        shmem.signal_wait_until(bsem.at(), shmem.CMP_GE, 2)
+
+
+def make_xslice_mesh(slices: int, n_local: int, devices=None,
+                     dcn_axis: str = DCN_AXIS, ici_axis: str = "tp"):
+    """A ("dcn", "tp") mesh over `slices * n_local` devices, DCN-major
+    (device order matches `SliceTeam.globalize`). On the CPU test rig
+    the devices come from the virtual 12-device pool (tests/conftest);
+    on real multi-slice hardware `devices` arrives pre-ordered from
+    `jax.devices()` after `runtime.init` multi-host bring-up."""
+    from triton_dist_tpu.runtime import make_mesh, split_mesh
+
+    flat = make_mesh(mesh_shape=(slices * n_local,),
+                     axis_names=(ici_axis,), devices=devices)
+    return split_mesh(flat, ici_axis, (slices, n_local),
+                      (dcn_axis, ici_axis))
