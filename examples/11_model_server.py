@@ -92,7 +92,7 @@ def serve(sock, sch):
                     f.flush()
                 f.write(json.dumps({"gen": [r.out_tokens],
                                     "req": r.request_id}) + "\n")
-            except Exception as e:  # surface to the client
+            except Exception as e:  # noqa: BLE001 — surface to the client
                 import traceback
 
                 traceback.print_exc()

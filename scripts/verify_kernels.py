@@ -19,8 +19,17 @@ tests/test_verify.py):
 deliberately broken protocol be flagged with its registered diagnostic
 class — the verifier's own regression harness.
 
-No jax mesh is needed: the analysis is symbolic (pure python), so this
-runs anywhere in milliseconds.
+--conform closes the model-drift hole from the other side: it runs the
+REAL shipped kernels on a lockstep interpret mesh under
+conform.recording() and checks each per-rank recorded sync-op stream
+against the concretized protocol model (verify/conform.py). Exit 1 on
+any divergence; rig-impossible grid points are skipped LOUDLY with
+their reason.
+
+No jax mesh is needed for the default/symbolic modes: the analysis is
+pure python and runs anywhere in milliseconds. --mutants (the dynamic
+guard/drift cells) and --conform execute real kernels on the
+bootstrapped virtual CPU mesh.
 """
 
 from __future__ import annotations
@@ -111,6 +120,29 @@ def check_liveness_cli(names=None, verbose=False) -> int:
     return 1 if problems else 0
 
 
+def check_conform(names=None, verbose=False) -> int:
+    """Kernel<->model conformance (verify/conform.py): run every
+    registered conformance grid point — the REAL kernel on a lockstep
+    interpret mesh, its recorded sync-op stream checked against the
+    concretized protocol model. Skips are loud (each carries its rig
+    reason) but only findings fail the gate."""
+    from triton_dist_tpu.verify import conform
+
+    try:
+        findings, report = conform.check_shipped(names or None)
+    except conform.ConformError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for line in report:
+        print(line)
+    for f in findings:
+        print(f"  {f}")
+    n_skip = sum(" SKIP " in ln for ln in report)
+    print(f"verify_kernels --conform: {len(report)} grid point(s), "
+          f"{n_skip} skipped, {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
 def check_mutants(verbose=False) -> int:
     muts = _load_mutants()
     if not muts:
@@ -145,6 +177,10 @@ def main(argv=None) -> int:
     ap.add_argument("--liveness", action="store_true",
                     help="check every dropped signal/delivery maps to "
                          "a detected deadlock or race (never silent)")
+    ap.add_argument("--conform", action="store_true",
+                    help="record the REAL kernels on an interpret mesh "
+                         "and check each stream against its registered "
+                         "protocol model (kernel<->model drift gate)")
     ap.add_argument("--list", action="store_true",
                     help="list registered protocols and exit")
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -157,6 +193,8 @@ def main(argv=None) -> int:
         return 0
     if args.mutants:
         return check_mutants(verbose=args.verbose)
+    if args.conform:
+        return check_conform(args.names or None, verbose=args.verbose)
     if args.liveness:
         return check_liveness_cli(args.names or None,
                                   verbose=args.verbose)

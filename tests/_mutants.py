@@ -250,3 +250,124 @@ def _xslice_rail_before_rs(n, slices=2):
         _v.read(inbox.at(src_sid))
     _v.read(blk.at())
     _v.write(_v.ref("o").at())
+
+
+# -- model-drift mutants (DYNAMIC: verify/conform.py) -------------------------
+#
+# Each records the SHIPPED kernel's concrete sync-op stream on the
+# interpret mesh and compares it against a deliberately STALE model —
+# a realistic "kernel changed, model didn't" snapshot. The conformance
+# comparator must flag model-drift; a clean result means the checker
+# went blind to exactly the false-negative class it exists to close.
+# Each mutant drifts along a different comparator dimension (semaphore
+# slot structure, region keying, skeleton ops, cross-call identity).
+
+
+def _drift(name, n, stale_fn, params=None):
+    from triton_dist_tpu.verify import conform
+
+    params = params or {}
+    got = conform.record(name, n, **params)
+    if isinstance(got, conform.Skip):
+        return []  # rig cannot record: reads MISSED, never vacuous-pass
+    model = conform.model_streams(stale_fn, n, params)
+    return conform.compare_streams(got, model, kernel=f"drift:{name}",
+                                   n=n, params=params)
+
+
+@_v.mutant("drift_ag_shared_recv_slot", expect=_v.DRIFT, ns=(4,),
+           grid=({"method": "ring"},),
+           doc="stale ring-AG model waits every step on ONE shared recv "
+               "slot; the shipped kernel signals per-step slots — the "
+               "alpha canonicalization diverges at the first reuse")
+def _drift_ag_shared_recv_slot(n, method="ring"):
+    def stale(n, method="ring"):
+        me = shmem.my_pe(_AXIS)
+        x, o = _v.ref("x"), _v.ref("out")
+        lsem = _v.sem("local_sem")
+        send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+        shmem.neighbor_barrier(_AXIS, me, n)
+        _v.copy(o.at(me), x.at(), lsem.at()).wait()
+        for s in range(n - 1):
+            slot = (me - s) % n
+            shmem.putmem_nbi(o.at(slot), o.at(slot), send.at(),
+                             recv.at(0), (me + 1) % n, _AXIS).wait()
+        for j in range(n):
+            _v.read(o.at(j))
+
+    return _drift("allgather", n, stale, {"method": method})
+
+
+@_v.mutant("drift_ag_frozen_slot", expect=_v.DRIFT, ns=(4,),
+           grid=({"method": "ring"},),
+           doc="stale ring-AG model forwards chunk `me` every step "
+               "(the rotating slot forgotten); the kernel's recorded "
+               "put regions rotate — one model key lands on many "
+               "recorded regions (region-consistency drift)")
+def _drift_ag_frozen_slot(n, method="ring"):
+    def stale(n, method="ring"):
+        me = shmem.my_pe(_AXIS)
+        x, o = _v.ref("x"), _v.ref("out")
+        lsem = _v.sem("local_sem")
+        send, recv = _v.sem("send_sem"), _v.sem("recv_sem")
+        shmem.neighbor_barrier(_AXIS, me, n)
+        _v.copy(o.at(me), x.at(), lsem.at()).wait()
+        for s in range(n - 1):
+            shmem.putmem_nbi(o.at(me), o.at(me), send.at(),
+                             recv.at(s), (me + 1) % n, _AXIS).wait()
+        for j in range(n):
+            _v.read(o.at(j))
+
+    return _drift("allgather", n, stale, {"method": method})
+
+
+@_v.mutant("drift_rs_stale_no_credit", expect=_v.DRIFT, ns=(4,),
+           doc="stale RS model predating the credit flow control; the "
+               "shipped ring records credit signals/waits the model "
+               "does not declare (skeleton-op drift)")
+def _drift_rs_stale_no_credit(n):
+    def stale(n):
+        me = shmem.my_pe(_AXIS)
+        x, o = _v.ref("x"), _v.ref("o")
+        acc, stage = _v.ref("acc"), _v.ref("stage")
+        ld, st = _v.sem("ld_sem"), _v.sem("st_sem")
+        send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+        right = (me + 1) % n
+        shmem.neighbor_barrier(_AXIS, me, n)
+        _v.copy(acc.at(0), x.at((me - 1) % n), ld.at()).wait()
+        for s in range(n - 1):
+            cur, nxt = s % 2, (s + 1) % 2
+            h = shmem.putmem_nbi(acc.at(nxt), acc.at(cur), send.at(),
+                                 recv.at(nxt), right, _AXIS)
+            _v.copy(stage.at(), x.at((me - s - 2) % n), ld.at()).wait()
+            h.wait_send()
+            h.wait_recv()
+            _v.read(stage.at())
+            _v.read(acc.at(nxt))
+            _v.write(acc.at(nxt))
+        _v.copy(o.at(), acc.at((n - 1) % 2), st.at()).wait()
+
+    return _drift("reduce_scatter", n, stale)
+
+
+@_v.mutant("drift_ll_shared_parity_slot", expect=_v.DRIFT, ns=(4,),
+           grid=({"calls": 3},),
+           doc="stale LL-AG model waits every call on parity slot 0; "
+               "the shipped kernel alternates parity across calls — "
+               "drift in the CROSS-CALL semaphore identity the "
+               "collective_id namespace merge makes checkable")
+def _drift_ll_shared_parity_slot(n, calls=3):
+    def stale(n, calls=3):
+        x, buf = _v.ref("x"), _v.ref("buf")
+        lsem = _v.sem("local_sem")
+        send, recv = _v.sem("send_sem"), _v.sem("recv_sems")
+        for k in range(calls):
+            if k == 0:
+                shmem.barrier_all(_AXIS)
+            shmem.fcollect_slots(
+                lambda pe: buf.at(k % 2, pe), x,
+                lsem.at(), send.at(), recv.at(0), _AXIS, n)
+            for j in range(n):
+                _v.read(buf.at(k % 2, j))
+
+    return _drift("low_latency_allgather", n, stale, {"calls": calls})

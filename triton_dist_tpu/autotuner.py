@@ -180,7 +180,7 @@ class ContextualAutotuner:
                     perf_func(thunk, iters=iters, warmup_iters=warmup)[1]
                     for _ in range(reps)
                 )
-            except Exception as e:  # compile failure / OOM => skip
+            except Exception as e:  # noqa: BLE001 — compile failure / OOM => skip
                 if verbose:
                     print(f"[autotune {self.name}] {cfg!r} failed: {e}")
                 ms = float("inf")

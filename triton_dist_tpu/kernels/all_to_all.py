@@ -56,26 +56,11 @@ def _a2a_kernel(axis: str, n: int, x_ref, s_ref, o_ref, os_ref,
     handles = []
     for i in range(1, n):
         peer = jnp.mod(me + i, n)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=x_ref.at[peer],
-            dst_ref=o_ref.at[me],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        handles.append(rdma)
-        meta = pltpu.make_async_remote_copy(
-            src_ref=s_ref.at[peer],
-            dst_ref=os_ref.at[me],
-            send_sem=meta_send_sem,
-            recv_sem=meta_recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        meta.start()
-        handles.append(meta)
+        handles.append(shmem.putmem_nbi(
+            o_ref.at[me], x_ref.at[peer], send_sem, recv_sem, peer, axis))
+        handles.append(shmem.putmem_nbi(
+            os_ref.at[me], s_ref.at[peer], meta_send_sem, meta_recv_sem,
+            peer, axis))
     cp.wait()
     cps.start()
     cps.wait()
@@ -430,3 +415,40 @@ def _a2a_chunked_protocol(n, q=2):
         m.wait()
     for j in range(n):
         _v.read(os_.at(j))
+
+
+# -- conformance runners (verify.conform: recorded kernel vs model) -----------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "all_to_all", grids=((4, {}),),
+    doc="single-shot segment exchange on the interpret mesh")
+def _a2a_conform(n):
+    mesh = _conform.team_mesh(n, (EP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    x = jnp.ones((n * n, 8, 128), jnp.float32)
+    sp = jnp.ones((n * n,), jnp.int32)
+    return _conform.collect_streams(
+        mesh, EP_AXIS, lambda v, s: all_to_all(v, s, EP_AXIS),
+        in_specs=(_P(EP_AXIS), _P(EP_AXIS)), args=(x, sp))
+
+
+@_conform.conforms(
+    "all_to_all_chunked",
+    grids=((4, {"q": 1}), (4, {"q": 2}), (4, {"q": 4})),
+    doc="chunk-granular A2A: per-(step, chunk) delivery slots")
+def _a2a_chunked_conform(n, q=2):
+    mesh = _conform.team_mesh(n, (EP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    x = jnp.ones((n * n, 8, 128), jnp.float32)
+    sp = jnp.ones((n * n,), jnp.int32)
+    return _conform.collect_streams(
+        mesh, EP_AXIS,
+        lambda v, s: all_to_all_chunked(v, s, EP_AXIS, n_chunks=q),
+        in_specs=(_P(EP_AXIS), _P(EP_AXIS)), args=(x, sp))

@@ -431,3 +431,27 @@ def _ag_protocol(n, method="ring", prefix="", fmt="native", space=None):
         h.wait()
     for j in range(n):
         _v.read(o.at(j))  # consume edge (wire: the per-slot decode)
+
+
+# -- conformance runners (verify.conform: recorded kernel vs model) -----------
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "allgather",
+    grids=((4, {"method": "ring"}), (4, {"method": "full_mesh"}),
+           (4, {"method": "ring", "fmt": "fp8"}),
+           (4, {"method": "full_mesh", "fmt": "fp8"}),
+           (4, {"method": "ring", "fmt": "int8"})),
+    doc="ring_all_gather / full_mesh_all_gather on the interpret mesh")
+def _ag_conform(n, method="ring", fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+    entry = ring_all_gather if method == "ring" else full_mesh_all_gather
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    return _conform.collect_streams(
+        mesh, TP_AXIS, lambda v: entry(v, TP_AXIS, wire_format=wf),
+        in_specs=P(TP_AXIS), args=(x,))

@@ -74,6 +74,7 @@ from triton_dist_tpu.lang.core import (
 from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.runtime.init import TP_AXIS
 from triton_dist_tpu.trace import events as trace_ev
+from triton_dist_tpu.verify import conform as _conform
 from triton_dist_tpu.wire import codec as wcodec
 
 
@@ -253,6 +254,14 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         if octx is not None:
             octx.add_bytes(ws_send_bytes)
 
+    def note_fwd(c_idx, step):
+        # conformance record for a ring forward start; the wait notes
+        # reconstruct the idents (the descriptor itself is rebuilt in a
+        # later grid step, so no PutHandle can be threaded there)
+        _conform.note_put(send_sem, recv_sems.at[step], right,
+                          ws_ref.at[pl.ds(c_idx * m_loc, m_loc)],
+                          ws_send_bytes)
+
     def a_wait(slot):
         # descriptor only carries the byte count for the semaphore wait
         with _obs.span(tctx, octx, R["ag.a_wait"], payload=flat, aux=s):
@@ -305,6 +314,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                 # single-tile grids have no later slot to defer to
                 local_copy().wait()
                 fwd_copy(me, 0).start()
+                note_fwd(me, 0)
                 meter_fwd()
 
         if n > 1 and total > 1:
@@ -315,6 +325,7 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             def _start_ring():
                 local_copy().wait()
                 fwd_copy(me, 0).start()
+                note_fwd(me, 0)
                 meter_fwd()
 
         if n == 1:
@@ -328,12 +339,15 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
         def _later_steps():
             prev_chunk = jnp.mod(me - s + 1, n)
             prev = fwd_copy(prev_chunk, s - 1)
+            idents = _conform.put_idents(send_sem, recv_sems.at[s - 1])
             with _obs.span(tctx, octx, R["ag.ring_wait"], payload=s):
                 prev.wait_send()
+                _conform.note_wait_send(idents)
                 # consumer wait: this step's A rows have landed
                 # (the dl.wait/consume_token contract, ref :236-237).
                 if gctx is None:
                     prev.wait_recv()
+                    _conform.note_wait_recv(idents)
                 else:
                     # bounded ring-step watchdog: readiness is the full
                     # chunk's element count (interpreter discharge) or
@@ -347,10 +361,12 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
                     _guard.watchdog_wait(
                         prev.wait_recv, recv_sems.at[s - 1], amount,
                         "ring", slot=s, ctx=gctx)
+                    _conform.note_wait_recv(idents)
 
             @pl.when(s < n - 1)
             def _():
                 fwd_copy(chunk, s).start()
+                note_fwd(chunk, s)
                 meter_fwd()
 
     # --- A-block staging.
@@ -831,3 +847,26 @@ def _ag_gemm_protocol(n, fmt="native"):
             prev = shmem.putmem_nbi(ws.at(chunk), ws.at(chunk),
                                     send.at(), recv.at(s),
                                     (me + 1) % n, TP_AXIS)
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+
+@_conform.conforms(
+    "allgather_gemm",
+    grids=((4, {}), (4, {"fmt": "fp8"})),
+    doc="overlapped AG+GEMM ring (inline notes thread the cross-step "
+        "descriptor idents) on the interpret mesh")
+def _ag_gemm_conform(n, fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+    a = jnp.ones((8, 128), jnp.float32)
+    b = jnp.ones((128, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, TP_AXIS,
+        lambda a_, b_: ag_gemm(a_, b_, TP_AXIS, wire_format=wf),
+        in_specs=(_P(), _P()), args=(a, b))

@@ -84,16 +84,8 @@ def _one_shot_ar_kernel(axis: str, n: int, x_ref, o_ref, ws, acc, ld_sem,
     handles = []
     for i in range(1, n):
         peer = jnp.mod(me + i, n)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=x_ref,
-            dst_ref=ws.at[me],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        handles.append(rdma)
+        handles.append(shmem.putmem_nbi(
+            ws.at[me], x_ref, send_sem, recv_sem, peer, axis))
     cp.wait()
     for h in handles:
         h.wait()
@@ -425,3 +417,31 @@ def _ar_protocol(n, method="one_shot", fmt="native"):
     _v.write(acc.at())
     st = _v.copy(o.at(), acc.at(), ld.at())
     st.wait()
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "allreduce",
+    grids=((4, {"method": "one_shot"}), (4, {"method": "two_shot"}),
+           (4, {"method": "two_shot", "fmt": "fp8"}),
+           (4, {"method": "two_shot", "fmt": "int8"})),
+    doc="one-shot workspace AR and two-shot RS+AG on the interpret mesh")
+def _ar_conform(n, method="one_shot", fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+    x = jnp.ones((8, 128), jnp.float32)
+    if method == "one_shot":
+        fn = lambda v: one_shot_all_reduce(v, TP_AXIS)  # noqa: E731
+    else:
+        fn = lambda v: two_shot_all_reduce(  # noqa: E731
+            v, TP_AXIS, wire_format=wf)
+    return _conform.collect_streams(
+        mesh, TP_AXIS, fn, in_specs=_P(), args=(x,))

@@ -681,3 +681,41 @@ def _ep_dispatch_protocol(n, q=2):
                  "consumer) over the chunked A2A")
 def _ep_combine_protocol(n, q=2):
     _a2a_chunked_protocol(n, q=q)
+
+
+# -- conformance runners (verify.conform) -------------------------------------
+#
+# The EP legs ride all_to_all_chunked unchanged (their registered models
+# ARE _a2a_chunked_protocol), so conformance drives the shared transport
+# entry at the matching chunk count — any drift in the transport flags
+# both EP protocols too.
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+def _ep_transport_conform(n, q):
+    mesh = _conform.team_mesh(n, (EP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    x = jnp.ones((n * n, 8, 128), jnp.float32)
+    sp = jnp.ones((n * n,), jnp.int32)
+    return _conform.collect_streams(
+        mesh, EP_AXIS,
+        lambda v, s: all_to_all_chunked(v, s, EP_AXIS, n_chunks=q),
+        in_specs=(_P(EP_AXIS), _P(EP_AXIS)), args=(x, sp))
+
+
+@_conform.conforms(
+    "ep_dispatch_chunked", grids=((4, {"q": 2}), (4, {"q": 4})),
+    doc="EP dispatch leg = the chunked A2A transport")
+def _ep_dispatch_conform(n, q=2):
+    return _ep_transport_conform(n, q)
+
+
+@_conform.conforms(
+    "ep_combine_chunked", grids=((4, {"q": 2}), (4, {"q": 4})),
+    doc="EP combine leg = the chunked A2A transport")
+def _ep_combine_conform(n, q=2):
+    return _ep_transport_conform(n, q)

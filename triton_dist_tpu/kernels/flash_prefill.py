@@ -722,3 +722,27 @@ def _fp_protocol(n):
     for i in range(1, n):
         for h in handles[i]:
             h.wait_send()
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "flash_prefill",
+    grids=((4, {}),),
+    doc="ring-rotated KV flash prefill on the interpret mesh")
+def _fp_conform(n):
+    mesh = _conform.team_mesh(n, (SP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    q = jnp.ones((1, 8, 1, 128), jnp.float32)
+    k = jnp.ones((1, 8, 1, 128), jnp.float32)
+    v = jnp.ones((1, 8, 1, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, SP_AXIS,
+        lambda q_, k_, v_: sp_flash_prefill(q_, k_, v_, SP_AXIS),
+        in_specs=(_P(), _P(), _P()), args=(q, k, v))

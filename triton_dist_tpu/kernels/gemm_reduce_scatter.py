@@ -205,10 +205,8 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
     shmem.straggler_delay(axis, *straggler)
     # Step-0 incoming targets our slot 1 (free): grant left one credit
     # (flow-control protocol of reduce_scatter._ring_rs_kernel).
-    pltpu.semaphore_signal(
-        credit_sem, inc=1, device_id={axis: left},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
+    shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left, axis,
+                 label="credit")
 
     # Compute our partial of the first travelling chunk, (me-1) mod n.
     with trace_ev.span(tctx, R["rs.partial"], payload=0):
@@ -219,28 +217,20 @@ def _rs_ring(axis, n, straggler, partial_fn, o_ref, acc, stage, st_sem,
     for s in range(n - 1):
         cur, nxt = s % 2, (s + 1) % 2
         with trace_ev.span(tctx, R["rs.credit"], payload=s):
-            pltpu.semaphore_wait(credit_sem, 1)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=acc.at[cur],
-            dst_ref=acc.at[nxt],
-            send_sem=send_sem,
-            recv_sem=recv_sems.at[nxt],
-            device_id={axis: right},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
+            shmem.signal_wait_until(credit_sem, shmem.CMP_GE, 1,
+                                    site="credit", slot=s)
+        h = shmem.putmem_nbi(acc.at[nxt], acc.at[cur], send_sem,
+                             recv_sems.at[nxt], right, axis)
         # MXU fills the stage with our partial of the incoming chunk while
         # the hop is in flight — this is the producer/consumer overlap.
         with trace_ev.span(tctx, R["rs.partial"], payload=s + 1):
             partial_fn(jnp.mod(me - s - 2, n), stage)
         with trace_ev.span(tctx, R["rs.hop"], payload=s):
-            rdma.wait_send()
+            h.wait_send()
             if s + 1 <= n - 2:
-                pltpu.semaphore_signal(
-                    credit_sem, inc=1, device_id={axis: left},
-                    device_id_type=pltpu.DeviceIdType.MESH,
-                )
-            rdma.wait_recv()
+                shmem.signal(credit_sem, 1, shmem.SIGNAL_ADD, left,
+                             axis, label="credit")
+            h.wait_recv(slot=s)
         if wirefmt:
             k = stage.shape[-1]
             val = wcodec.decode_rows(acc[nxt], k, wirefmt, jnp.float32) \
@@ -668,3 +658,27 @@ def _gemm_rs_protocol(n, fmt="native"):
         _v.read(b.at())
 
     _ring_rs_skeleton(n, fill_stage, fmt=fmt)
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "gemm_reduce_scatter",
+    grids=((4, {}), (4, {"fmt": "fp8"}), (4, {"fmt": "int8"})),
+    doc="resident-regime fused GEMM+RS ring on the interpret mesh")
+def _gemm_rs_conform(n, fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+    a = jnp.ones((8, 128), jnp.float32)
+    b = jnp.ones((128, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, TP_AXIS,
+        lambda a_, b_: gemm_rs(a_, b_, TP_AXIS, wire_format=wf),
+        in_specs=(_P(), _P()), args=(a, b))

@@ -453,3 +453,33 @@ def _ll_ag_protocol(n, calls=3, fmt="native"):
         )
         for j in range(n):
             _v.read(buf.at(parity, j))  # consume (wire: per-slot decode)
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "low_latency_allgather",
+    grids=((4, {"calls": 1}), (4, {"calls": 3}),
+           (4, {"calls": 3, "fmt": "fp8"})),
+    doc="double-buffered LL AG across repeated calls on the interpret mesh")
+def _ll_ag_conform(n, calls=3, fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+
+    def run(v):
+        buf = create_ll_ag_buffer(v.shape, v.dtype, n, wire_format=wf)
+        out = v
+        for kk in range(calls):
+            out, buf = ll_all_gather(v, buf, kk, TP_AXIS, wire_format=wf)
+        return out
+
+    x = jnp.ones((8, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, TP_AXIS, run, in_specs=_P(), args=(x,))

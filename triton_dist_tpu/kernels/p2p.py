@@ -133,16 +133,8 @@ def ring_shift(x: jax.Array, shift: int = 1, axis: str = PP_AXIS) -> jax.Array:
             shmem.neighbor_barrier(axis, me, n)
         else:
             shmem.barrier_all(axis)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=x_ref,
-            dst_ref=o_ref,
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: jnp.mod(me + shift, n)},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        rdma.start()
-        rdma.wait()
+        shmem.putmem_nbi(o_ref, x_ref, send_sem, recv_sem,
+                         jnp.mod(me + shift, n), axis).wait()
 
     return tpu_call(
         kernel,
@@ -191,3 +183,36 @@ def _ring_shift_protocol(n, shift=1):
                          (me + shift) % n, PP_AXIS)
     h.wait()
     _v.read(o.at())
+
+
+# -- conformance runners (verify.conform) -------------------------------------
+
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "ring_shift",
+    grids=((4, {"shift": 1}), (4, {"shift": 3})),
+    doc="neighbor-barriered ring rotation on the interpret mesh")
+def _ring_shift_conform(n, shift=1):
+    mesh = _conform.team_mesh(n, (PP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    x = jnp.ones((8, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, PP_AXIS, lambda v: ring_shift(v, shift, PP_AXIS),
+        in_specs=_P(), args=(x,))
+
+
+@_conform.conforms(
+    "broadcast",
+    grids=((4, {"root": 0}), (4, {"root": 1})),
+    doc="root-guarded fan-out (rank-divergent; see skip reason)")
+def _broadcast_conform(n, root=0):
+    return _conform.Skip(
+        "rank-divergent protocol (root-guarded fan-out): the legacy "
+        "lockstep interpreter cannot execute divergent Pallas branches, "
+        "so broadcast routes to the value-level XLA fallback on this "
+        "rig, which records no kernel stream")

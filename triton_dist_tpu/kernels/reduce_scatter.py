@@ -659,3 +659,26 @@ def _rs_protocol(n, prefix="", fmt="native", space=None):
         _v.copy(dst, x.at(chunk), ld.at()).wait()
 
     _ring_rs_skeleton(n, fill_stage, prefix=prefix, fmt=fmt, space=space)
+
+
+# -- conformance runner (verify.conform) --------------------------------------
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+
+@_conform.conforms(
+    "reduce_scatter",
+    grids=((4, {}), (4, {"fmt": "fp8"}), (4, {"fmt": "int8"})),
+    doc="credit-flow ring RS entry on the interpret mesh")
+def _rs_conform(n, fmt="native"):
+    mesh = _conform.team_mesh(n, (TP_AXIS,))
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    wf = None if fmt == "native" else fmt
+    # every rank holds its own full contribution: replicated input is
+    # exact for conformance (the sync skeleton is data-independent)
+    x = jnp.ones((n * 2, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, TP_AXIS,
+        lambda v: ring_reduce_scatter(v, TP_AXIS, wire_format=wf),
+        in_specs=P(), args=(x,))

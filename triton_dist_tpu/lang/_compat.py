@@ -121,7 +121,7 @@ def install() -> None:
     if os.environ.get("JAX_USE_SHARDY_PARTITIONER") is None:
         try:
             jax.config.update("jax_use_shardy_partitioner", True)
-        except Exception:  # unknown flag on some builds — keep GSPMD
+        except Exception:  # noqa: BLE001 — unknown flag on some builds; keep GSPMD
             pass
 
     from jax._src import core as jax_core

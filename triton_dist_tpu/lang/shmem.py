@@ -39,6 +39,7 @@ from triton_dist_tpu.faults import plan as _fplan
 from triton_dist_tpu.lang import _compat
 from triton_dist_tpu.obs import stats as _obs
 from triton_dist_tpu.verify import capture as _vcap
+from triton_dist_tpu.verify import conform as _conform
 
 _compat.install()
 
@@ -121,6 +122,9 @@ class PutHandle:
     recv_sem: Any = None
     elems: int = 0
     nbytes: int = 0
+    # semaphore identities the conformance recorder threaded through
+    # note_put (None whenever recording is off — the common case)
+    conform_idents: Any = None
 
     def _recv_amount(self) -> int:
         from triton_dist_tpu.lang.core import use_interpret
@@ -128,6 +132,7 @@ class PutHandle:
         return self.elems if use_interpret() else self.nbytes
 
     def wait_send(self):
+        _conform.note_wait_send(self.conform_idents)
         self.copy.wait_send()
 
     def wait_recv(self, slot=0):
@@ -137,6 +142,7 @@ class PutHandle:
         Under an active guard build this is a bounded watchdog wait: on
         deadline the kernel records a structured guard row and continues
         instead of hanging (the host raises DeadlineExceeded)."""
+        _conform.note_wait_recv(self.conform_idents)
         if _guard.current() is None or self.recv_sem is None:
             self.copy.wait_recv()
         else:
@@ -183,7 +189,9 @@ def putmem_nbi(
     # the wire — quantized legs put int8 wire images, so the byte
     # ledger is per-format without a side channel
     _obs.meter_send(nbytes)
-    return PutHandle(copy, recv_sem=recv_sem, elems=elems, nbytes=nbytes)
+    idents = _conform.note_put(send_sem, recv_sem, pe, dst_ref, nbytes)
+    return PutHandle(copy, recv_sem=recv_sem, elems=elems, nbytes=nbytes,
+                     conform_idents=idents)
 
 
 def putmem(dst_ref, src_ref, send_sem, recv_sem, pe, axis: AxisName) -> None:
@@ -256,6 +264,7 @@ def signal(sig_sem, value, sig_op, pe, axis: AxisName,
     if cap is not None:
         cap.signal(sig_sem, value, pe)
         return
+    _conform.note_signal(sig_sem, value, pe)
     pltpu.semaphore_signal(
         sig_sem,
         inc=_fault_signal_mask(value, axis, label),
@@ -270,6 +279,7 @@ def signal_local(sig_sem, value=1) -> None:
     if cap is not None:
         cap.signal(sig_sem, value, pe=None)
         return
+    _conform.note_signal(sig_sem, value, None)
     pltpu.semaphore_signal(sig_sem, inc=value)
 
 
@@ -291,6 +301,7 @@ def signal_wait_until(sig_sem, cmp, value, site: str = "wait",
     if cap is not None:
         cap.wait(sig_sem, value)
         return
+    _conform.note_wait(sig_sem, value)
     if _guard.current() is None:
         pltpu.semaphore_wait(sig_sem, value)
     else:
@@ -336,6 +347,9 @@ def barrier_all(axis: AxisName) -> None:
     if cap is not None:
         cap.barrier()
         return
+    # one barrier note (the fan-out below signals through raw pltpu
+    # calls, so nothing double-records)
+    _conform.note_barrier()
     if isinstance(axis, str):
         n = jax.lax.axis_size(axis)
     else:
@@ -388,10 +402,16 @@ def neighbor_barrier(axis: str, me, n: int) -> None:
     def with_sem(bsem):
         inc = _fault_signal_mask(1, axis, "barrier")
         for d in (jax.lax.rem(me - 1 + n, n), jax.lax.rem(me + 1, n)):
+            # recorded under the reserved NBAR identity: the model
+            # shares one symbolic "__nbar__" sem across barriers while
+            # the hardware scopes a fresh collective semaphore each
+            # time — a naming difference with no protocol content
+            _conform.note_signal(bsem, 1, d, nbar=True)
             pltpu.semaphore_signal(
                 bsem, inc=inc, device_id={axis: d},
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
+        _conform.note_wait(bsem, 2, nbar=True)
         if _guard.current() is None:
             pltpu.semaphore_wait(bsem, 2)
         else:

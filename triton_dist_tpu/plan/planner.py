@@ -200,7 +200,7 @@ def _top_config(pattern: str, cons_or_prod, world: int, chip) -> str:
         else:
             return ""
         return str(picks[0]) if picks else ""
-    except Exception:  # pricing witness only — never block planning
+    except Exception:  # noqa: BLE001 — pricing witness only; never block planning
         return ""
 
 

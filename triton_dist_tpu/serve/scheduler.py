@@ -67,7 +67,7 @@ from triton_dist_tpu.obs.recorder import FlightRecorder
 from triton_dist_tpu.obs.registry import Registry
 from triton_dist_tpu.serve.kv_pool import KVPool, PoolExhausted, pages_for
 from triton_dist_tpu.serve.prefix import PrefixCache
-from triton_dist_tpu.serve.queue import RequestQueue
+from triton_dist_tpu.serve.queue import QueueFull, RequestQueue
 from triton_dist_tpu.serve.request import (
     LATENCY_BUCKETS,
     Detokenizer,
@@ -383,7 +383,7 @@ class Scheduler:
         self._begin_phase(req, "queued")
         try:
             self.queue.submit(req)
-        except Exception:
+        except QueueFull:
             self.obs.inc("serve_rejected", site="queue_full")
             raise
         self.obs.inc("serve_submitted")

@@ -50,6 +50,7 @@ from triton_dist_tpu.verify.capture import write  # noqa: F401
 from triton_dist_tpu.verify.engine import (  # noqa: F401
     CLASSES,
     DEADLOCK,
+    DRIFT,
     LEAK,
     RACE,
     Execution,
@@ -60,6 +61,18 @@ from triton_dist_tpu.verify.engine import (  # noqa: F401
     execute,
     protocol_skeleton,
     run_protocol,
+)
+
+# conform must import after capture/engine (it consumes both) and
+# before registry's kernel modules ever load (its import installs the
+# tpu_call recording hook the kernels' conformance runners rely on).
+from triton_dist_tpu.verify import conform  # noqa: F401
+from triton_dist_tpu.verify.conform import (  # noqa: F401
+    ConformSpec,
+    Skip,
+    check_shipped as check_conform,
+    conforms,
+    recording,
 )
 from triton_dist_tpu.verify.hb import CycleError, HBGraph  # noqa: F401
 from triton_dist_tpu.verify.liveness import (  # noqa: F401

@@ -63,7 +63,11 @@ LEAK = "sem-leak"
 # (the guard-polarity mutants — evaluated by the chaos harness, not the
 # HB engine; registry.verify_spec dispatches on it)
 GUARD = "guard-no-trip"
-CLASSES = (DEADLOCK, RACE, LEAK, GUARD)
+# dynamic class: the shipped kernel's RECORDED sync-op stream diverges
+# from its registered protocol model (kernel edited, model left stale —
+# evaluated by verify/conform.py, not the HB engine)
+DRIFT = "model-drift"
+CLASSES = (DEADLOCK, RACE, LEAK, GUARD, DRIFT)
 
 
 @dataclasses.dataclass(frozen=True)

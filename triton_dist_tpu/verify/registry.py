@@ -107,13 +107,15 @@ def mutants() -> Dict[str, ProtocolSpec]:
 
 def verify_spec(spec: ProtocolSpec) -> List[engine.Finding]:
     """All findings for one registered protocol across its team sizes
-    and parameter grid. GUARD-class mutants are DYNAMIC: their fn runs
-    the real kernels under fault injection (faults/chaos.py) and
-    returns its own findings instead of being captured symbolically."""
+    and parameter grid. GUARD- and DRIFT-class mutants are DYNAMIC:
+    their fn runs the real kernels (under fault injection for GUARD —
+    faults/chaos.py — and under conformance recording for DRIFT —
+    verify/conform.py) and returns its own findings instead of being
+    captured symbolically."""
     out: List[engine.Finding] = []
     for n in spec.ns:
         for params in spec.grid:
-            if spec.expect == engine.GUARD:
+            if spec.expect in (engine.GUARD, engine.DRIFT):
                 import dataclasses as _dc
 
                 ptup = tuple(sorted(params.items()))

@@ -176,21 +176,26 @@ def hier_reduce_scatter(x, dcn_axis: str = DCN_AXIS,
 
 def hier_all_reduce(x, dcn_axis: str = DCN_AXIS,
                     ici_axis: str = TP_AXIS, wire_format=None,
-                    chunks: int = 1):
+                    chunks: int = 1,
+                    rs_method=ReduceScatterMethod.Auto,
+                    ag_method: AllGatherMethod = AllGatherMethod.Auto):
     """Two-level AR, per-device: (R, ...) -> (R, ...) summed over the
     whole team. RS over the ICI ring, AR across the DCN hop (wire
     image + fixed-order decode-sum when quantized), AG back over the
     ICI ring — the two-shot composition with the slow hop pinched to
-    1/n_local of the payload."""
+    1/n_local of the payload. `rs_method` / `ag_method` pin the ICI-leg
+    protocols past the byte-threshold auto dispatch (the registered
+    xslice_allreduce model declares the ring skeletons, so conformance
+    checking pins ring explicitly)."""
     fmt = wcodec.resolve(wire_format)
     slices = jax.lax.axis_size(dcn_axis)
 
     def ici(piece):
-        return reduce_scatter(piece, ici_axis)
+        return reduce_scatter(piece, ici_axis, method=rs_method)
 
     def dcn_then_ag(part):
         summed = _dcn_sum(part, dcn_axis, slices, fmt)
-        return all_gather(summed, ici_axis)
+        return all_gather(summed, ici_axis, method=ag_method)
 
     outs = _pipelined(_split(x, chunks), ici, dcn_then_ag)
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
@@ -342,3 +347,85 @@ def _xar_protocol(n, slices=2, fmt="native"):
     _v.read(_v.ref("dcn.blk").at())
     _v.write(_v.ref("ar").at())
     _ag_protocol(team.n_local, method="ring", prefix="ag.", space=team)
+
+
+# -- conformance runners (verify.conform) -------------------------------------
+#
+# The DCN hop is an XLA leg (lax collectives / _dcn_* helpers) and
+# records no kernel stream; conformance checks the Pallas ICI legs
+# against the model with the "dcn."-prefixed ops filtered out
+# (docs/verification.md "Conformance", XLA-owned legs). Recorded ICI
+# peers are tp-local on the (slices, n_local) mesh; peer_xform lifts
+# them to the model's global (dcn-major) rank space.
+
+from triton_dist_tpu.verify import conform as _conform  # noqa: E402
+
+_XCONFORM_GRID = ((4, {"slices": 2}), (4, {"slices": 2, "fmt": "fp8"}),
+                  (4, {"slices": 2, "fmt": "int8"}))
+
+
+def _ici_only(params):
+    del params
+
+    def keep(op):
+        for k in ("sem", "send_sem", "recv_sem"):
+            s = op.f.get(k)
+            if s is not None and isinstance(s[0], str) \
+                    and s[0].startswith("dcn."):
+                return False
+        return True
+
+    return keep
+
+
+def _xmesh(n, slices):
+    if n % slices:
+        return _conform.Skip(f"n={n} not divisible by slices={slices}")
+    return _conform.team_mesh((slices, n // slices),
+                              (DCN_AXIS, TP_AXIS))
+
+
+def _globalize(n, slices):
+    n_local = n // slices
+    return lambda r, p: (r // n_local) * n_local + p
+
+
+def _xconform(n, slices, fmt, fn):
+    mesh = _xmesh(n, slices)
+    if isinstance(mesh, _conform.Skip):
+        return mesh
+    x = jnp.ones((8, 128), jnp.float32)
+    return _conform.collect_streams(
+        mesh, (DCN_AXIS, TP_AXIS), fn, in_specs=P(), args=(x,),
+        peer_xform=_globalize(n, slices))
+
+
+@_conform.conforms(
+    "xslice_allgather", grids=_XCONFORM_GRID,
+    model_filter=_ici_only,
+    doc="ICI ring-AG leg of the 2-level AG (DCN rail leg is XLA)")
+def _xag_conform(n, slices=2, fmt="native"):
+    wf = None if fmt == "native" else fmt
+    return _xconform(n, slices, fmt, lambda v: hier_all_gather(
+        v, wire_format=wf, ici_method=AllGatherMethod.Ring1D))
+
+
+@_conform.conforms(
+    "xslice_reduce_scatter", grids=_XCONFORM_GRID,
+    model_filter=_ici_only,
+    doc="ICI credit-ring RS leg of the 2-level RS (DCN leg is XLA)")
+def _xrs_conform(n, slices=2, fmt="native"):
+    wf = None if fmt == "native" else fmt
+    return _xconform(n, slices, fmt, lambda v: hier_reduce_scatter(
+        v, wire_format=wf, ici_method=ReduceScatterMethod.Ring1D))
+
+
+@_conform.conforms(
+    "xslice_allreduce", grids=_XCONFORM_GRID,
+    model_filter=_ici_only,
+    doc="ICI RS + AG legs of the 2-level AR (DCN leg is XLA)")
+def _xar_conform(n, slices=2, fmt="native"):
+    wf = None if fmt == "native" else fmt
+    return _xconform(n, slices, fmt, lambda v: hier_all_reduce(
+        v, wire_format=wf, rs_method=ReduceScatterMethod.Ring1D,
+        ag_method=AllGatherMethod.Ring1D))
