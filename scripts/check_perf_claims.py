@@ -83,6 +83,10 @@ REQUIRED_CLAIMS = (
     # disaggregated prefill/decode + 2-level collectives (ISSUE 18)
     ("xslice_disagg_vs_single_tokens", "docs/serving.md"),
     ("xslice_ag_vs_flat", "docs/performance.md"),
+    # the tuning loop (ISSUE 20): the cache-winner launch must never
+    # measure worse than the hard-coded default it overrides
+    ("gemm_rs_tuned_vs_default", "docs/performance.md"),
+    ("flash_prefill_tuned_vs_default", "docs/performance.md"),
 )
 
 # Keys whose claims are REQUIRED but whose first measurement is still
@@ -106,12 +110,18 @@ PENDING_FIRST_ARTIFACT = {
     # bites only if a later round drops the arms, and dies at round 17
     "plan_vs_hand_prefill": 17,
     "plan_recover_misroute_ratio": 17,
-    # ISSUE 18: the xslice families ship before their first bench
-    # round — the newest artifact (r08) predates the arms, so the
-    # grace is LIVE until the next driver round measures them, and
-    # dies by itself at round 19
+    # ISSUE 18: the xslice families shipped before their first bench
+    # round; BENCH_r09.json (cpu-world1 rig) measures both, so the
+    # grace is retired to inert — it bites only if a later round drops
+    # the arms, and dies by itself at round 19
     "xslice_disagg_vs_single_tokens": 19,
     "xslice_ag_vs_flat": 19,
+    # ISSUE 20: the tuning-loop family lands measured in the same
+    # round it ships (BENCH_r09.json), so this grace is inert from
+    # birth — it bites only if a later round drops the sweep, and dies
+    # by itself at round 20
+    "gemm_rs_tuned_vs_default": 20,
+    "flash_prefill_tuned_vs_default": 20,
 }
 
 

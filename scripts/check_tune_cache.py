@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Validate the committed autotune cache (TUNE_CACHE.json) against the
+current code — the CI gate that keeps "measured beats modeled" honest.
+
+A cached winner is a promise that a specific config still launches: the
+kernel family exists, the config string parses into today's dataclass
+(a renamed field is a loud failure here, not a silent default at plan
+time), the rig tag names a chip the perf model knows, and the tiles
+still pass the same launch VMEM/fit gates `plan_forward` re-validates
+at apply time. A stale entry would not corrupt results — the planner
+degrades it loudly to the default — but committing one means the bench
+sweep and the code have drifted apart, which is exactly what this gate
+exists to catch before merge.
+
+Exit codes (CI contract, wired into __graft_entry__'s dryrun plane and
+.github/workflows/ci.yml next to plan_report):
+
+  0  no cache file (the gate bootstraps), or every entry valid
+  1  corrupt file / schema violation / unknown kernel family or rig /
+     unparseable config / a config that fails today's fit gates
+  2  usage errors
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_RIG_WORLD_SEP = "-world"
+
+
+def _chip_for_rig(rig: str):
+    """ChipSpec for a rig tag ("<chip.name>-world<n>"), or None."""
+    from triton_dist_tpu.perf_model import CHIPS
+
+    name = rig.rsplit(_RIG_WORLD_SEP, 1)[0]
+    for spec in CHIPS.values():
+        if spec.name == name:
+            return spec
+    return None
+
+
+def check_cache(path: str) -> list:
+    """Every problem with the cache at `path`, as printable strings."""
+    from triton_dist_tpu import autotuner as at
+
+    problems = []
+    try:
+        cache = at.TuneCache(path)
+    except ValueError as e:
+        return [f"cache failed to load: {e}"]
+
+    for key, entry in sorted(cache.entries.items()):
+        kernel, bucket, dtype, world, wire, rig = json.loads(key)
+        where = f"{kernel} {tuple(bucket)} {dtype} world={world} rig={rig}"
+
+        if kernel not in at._CONFIG_CLASS_OF:
+            problems.append(f"{where}: unknown kernel family")
+            continue
+        chip = _chip_for_rig(rig)
+        tail = rig.rsplit(_RIG_WORLD_SEP, 1)
+        if chip is None or len(tail) != 2 or not tail[1].isdigit():
+            problems.append(
+                f"{where}: rig tag does not name a known chip "
+                f"(expect '<chip>{_RIG_WORLD_SEP}<n>' with <chip> from "
+                "perf_model.CHIPS)")
+            continue
+        try:
+            cfg = at.parse_config(kernel, entry["config"])
+        except ValueError as e:
+            problems.append(f"{where}: config no longer parses: {e}")
+            continue
+
+        # The same launch gates plan_forward applies — a committed
+        # winner that today's code would refuse to launch is stale.
+        ok = True
+        if kernel in ("ag_gemm",):
+            m, k, n = bucket
+            ok = at.ag_gemm_config_fits(cfg, m, k, n, chip=chip)
+        elif kernel in ("gemm_rs",) and int(world) <= 1:
+            m, k, n = bucket
+            ok = at.gemm_rs_local_config_fits(cfg, m, k, n, chip=chip)
+        elif kernel == "flash_prefill":
+            s_q, t, hq, hkv, d = bucket
+            ok = at.flash_prefill_config_fits(cfg, s_q, t, hq, hkv, d,
+                                              dtype=dtype, chip=chip)
+        elif kernel == "ep_moe":
+            ok = int(getattr(cfg, "n_chunks", 0)) >= 1
+        if not ok:
+            problems.append(
+                f"{where}: cached config {entry['config']!r} fails "
+                "today's launch fit/VMEM gate — re-run the bench sweep")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate the committed autotune cache")
+    ap.add_argument("path", nargs="?",
+                    default=os.path.join(_REPO, "TUNE_CACHE.json"),
+                    help="cache file (default: repo TUNE_CACHE.json)")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"check_tune_cache: no cache at {args.path} — nothing "
+              "committed yet, gate passes vacuously")
+        return 0
+    problems = check_cache(args.path)
+    for p in problems:
+        print(f"STALE TUNE CACHE: {p}", file=sys.stderr)
+    n = "?"
+    try:
+        with open(args.path) as f:
+            n = len(json.load(f).get("entries", {}))
+    except (OSError, ValueError, AttributeError):
+        pass  # count is cosmetic; check_cache already reported the file
+    print(f"check_tune_cache: {args.path}: {n} entr(ies), "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

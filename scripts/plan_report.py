@@ -86,14 +86,22 @@ def render_plan(plan, out=sys.stdout) -> None:
             w(f"     {d.reason}\n")
         if d.config:
             w(f"     tile config (pricing witness): {d.config}\n")
+        if d.applied_config:
+            w(f"     applied config ({d.config_source}): "
+              f"{d.applied_config}\n")
+    if plan.attn_block is not None:
+        w(f"  attn.core applied block ({plan.attn_block_source}): "
+          f"{plan.attn_block}\n")
     w(f"  fused sites: {', '.join(plan.fused_sites()) or '(none)'}\n")
 
 
 # routing fields a --diff compares: the planner's DECISION, not its
 # prices (estimates drift with perf-model tuning; the route flipping is
-# what must never happen silently)
+# what must never happen silently). applied_config is a decision too —
+# a tune-cache winner silently starting (or stopping) to launch is
+# exactly the flip class this gate exists for.
 _ROUTE_FIELDS = ("pattern", "lowered", "kernel", "protocol", "wire",
-                 "fused")
+                 "fused", "applied_config")
 
 
 def _case_key(model, batch, seq, world, rig, mode) -> str:
@@ -111,6 +119,7 @@ def decision_table(cases) -> dict:
                 "pattern": d.pattern, "lowered": d.lowered,
                 "kernel": d.kernel, "protocol": d.protocol,
                 "wire": d.wire, "fused": bool(d.fused),
+                "applied_config": d.applied_config,
             }
             for d in plan.decisions
         }

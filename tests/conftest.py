@@ -18,6 +18,13 @@ Spare virtual devices = spare pool threads = guaranteed progress.
 
 import os
 
+# tier-1 is hermetic against the committed autotune cache: a bench round
+# landing TUNE_CACHE.json winners must never change test behavior (the
+# bitwise oracles assume default launches). Set-but-empty pins the empty
+# in-memory cache (autotuner.default_tune_cache_path); tests that want
+# winners inject them explicitly via autotuner.set_tune_cache.
+os.environ.setdefault("TDT_TUNE_CACHE", "")
+
 if os.environ.get("TDT_TEST_TPU", "") != "1":
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

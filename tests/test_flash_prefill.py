@@ -202,7 +202,10 @@ def test_sp_prefill_attention_switch(mesh8):
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(ring))
 
 
-@pytest.mark.parametrize("skew_rank", [2, 5])
+# delivery edges are offset-keyed, so one straggler position pins the
+# skew-visibility property — the PR-13 a2a argument applies verbatim
+# (tier-1 wall budget; deep runs keep the second position)
+@pytest.mark.parametrize("skew_rank", [2, pytest.param(5, marks=pytest.mark.slow)])
 def test_sp_flash_prefill_skew_visibility(mesh8, skew_rank):
     """ISSUE-7 satellite: a traced SP flash prefill under
     straggler_delay must make the skew attributable — every receiver's

@@ -32,7 +32,10 @@ def _mesh(n):
     return make_mesh((n,), ("tp",))
 
 
-@pytest.mark.parametrize("world", [1, 4])
+# world=1 decode parity is re-proven by the two-cores variant below at
+# world=1 WITH race detection on — this plain copy only duplicates it
+# (tier-1 wall budget, PR-8/PR-13 precedent; deep runs keep it)
+@pytest.mark.parametrize("world", [pytest.param(1, marks=pytest.mark.slow), 4])
 def test_mega_decode_matches_xla_engine(tiny_cfg, world):
     """Prefill with the regular Engine, then decode the same steps with
     the megakernel and with the XLA-mode engine; logits must agree."""
@@ -306,7 +309,11 @@ def test_mega_pf_depth_pipeline_parity(tiny_cfg, monkeypatch):
     )
 
 
-@pytest.mark.parametrize("world", [1, 4])
+# page-pool mechanics (on-demand allocation, shared capacity) are
+# per-slot and world-independent; the kept world=4 variant pins them
+# plus sharding, and test_serve exercises the world=1 paged plane
+# (tier-1 wall budget, PR-8/PR-13 precedent; deep runs keep it)
+@pytest.mark.parametrize("world", [pytest.param(1, marks=pytest.mark.slow), 4])
 def test_mega_paged_decode_matches_engine(tiny_cfg, world):
     """Paged-cache megakernel decode (shared page pool + on-demand
     allocation; round-4 verdict missing #5) == the XLA engine, across

@@ -219,32 +219,28 @@ def test_grace_ledger_retired():
     exactly the spec/prefix families under a round-14 gate, and ISSUE
     17 for the fusion-planner family under a round-17 gate — and the
     committed artifact series already MEASURES those graced keys
-    (r07 the spec/prefix pair, r08 the plan pair), so their grace is
-    inert (what it protects against is a later round dropping the
-    arms). ISSUE 18 re-arms it once more for the xslice families
-    under a round-19 gate — the newest committed artifact (r08)
-    PREDATES the arms, so that grace is LIVE until the next driver
-    round measures them, and the gate below pins that it dies by
-    itself the moment a round-19 artifact exists. Every other
-    required claim rides no grace."""
+    (r07 the spec/prefix pair, r08 the plan pair, r09 the xslice
+    pair), so their grace is inert (what it protects against is a
+    later round dropping the arms). ISSUE 20's tuning-loop pair
+    shipped MEASURED in its own round (BENCH_r09.json carries the
+    tuned_vs_default sweeps), so its round-20 grace is inert from
+    birth. With r09 landed, EVERY graced key is measured — no grace
+    is live, and every required claim is backed by an artifact."""
     cli = _load_claims_cli()
     assert cli.PENDING_FIRST_ARTIFACT == {
         "spec_vs_plain_tokens": 14, "prefix_hit_ttft": 14,
         "plan_vs_hand_prefill": 17, "plan_recover_misroute_ratio": 17,
-        "xslice_disagg_vs_single_tokens": 19, "xslice_ag_vs_flat": 19}
+        "xslice_disagg_vs_single_tokens": 19, "xslice_ag_vs_flat": 19,
+        "gemm_rs_tuned_vs_default": 20,
+        "flash_prefill_tuned_vs_default": 20}
     _label, measured = cli.latest_measured(REPO)
     live = set(cli.PENDING_FIRST_ARTIFACT) - set(measured)
-    # the LIVE graces (required claims no artifact backs yet) are
-    # exactly the ISSUE 18 pair awaiting their first bench round —
-    # every earlier graced key is measured, hence inert
-    assert live == {"xslice_disagg_vs_single_tokens",
-                    "xslice_ag_vs_flat"}
-    # and the grace actually covers them: the newest artifact
-    # predates their gate round, so the lint stays green today and
-    # fails closed the moment a round-19 artifact omits the arms
-    for key in live:
-        assert cli._artifact_round(_label) \
-            < cli.PENDING_FIRST_ARTIFACT[key]
+    # r09 measures the xslice AND tuned families, so no graced key
+    # is awaiting its first artifact — the whole ledger is inert
+    assert live == set()
+    # r09 predates every remaining gate round, so each grace still
+    # covers a later round that would DROP its arms (dies at its gate)
+    assert cli._artifact_round(_label) == 9
 
 
 def test_bench_r06_artifact_pins_resident_win():

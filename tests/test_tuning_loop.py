@@ -1,0 +1,617 @@
+"""The tuning loop (ISSUE 20): witness-config launches, the
+epsilon-band oracle, the persistent autotune cache.
+
+Contract under test, end to end:
+
+  off-switch   with an EMPTY tune cache, every plan carries zero
+               applied configs and the execute path compiles exactly
+               the legacy default-tile program (bitwise outputs +
+               unchanged pallas_call_count) — tuning that isn't
+               measured cannot change anything.
+  apply path   a MEASURED cache winner lands in
+               TripleDecision.applied_config / Plan.attn_block,
+               changes the plan_id, parses back into the kernel's
+               config class, and produces a DIFFERENT launched pallas
+               grid than the default (kernels' last_launch hook) —
+               while staying inside the epsilon band vs the default
+               launch.
+  oracle       the per-family drift bands admit fold-order
+               reassociation and reject wrong results (both
+               polarities pinned).
+  cache        roundtrip through disk, same-rig-only lookup, loud
+               failure on corrupt files, loud degrade (warning +
+               default) on entries today's code cannot launch.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu import autotuner as at
+from triton_dist_tpu.lang import core as lang_core
+from triton_dist_tpu.verify import epsilon
+
+BF16 = jnp.bfloat16
+
+
+@pytest.fixture
+def no_cache():
+    """Run with a guaranteed-empty active tune cache, restoring the
+    ambient one (possibly the committed repo cache) afterwards."""
+    prev = at.set_tune_cache(at.TuneCache())
+    yield
+    at.set_tune_cache(prev)
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    """2-device tp mesh: the launch-geometry pins don't need 8 ranks,
+    and an interpret-mode shard_map costs per rank — the smaller mesh
+    keeps this file's share of the tier-1 clock down."""
+    from triton_dist_tpu.runtime import make_mesh
+
+    return make_mesh(mesh_shape=(2,), axis_names=("tp",))
+
+
+# -- epsilon-band oracle -----------------------------------------------------
+
+
+def _two_fold_orders(dtype):
+    """The same exact matmul sum, folded two ways (one dot vs split-K
+    partial sums) — the reassociation class a tile override induces."""
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal((64, 256)) * 0.1, dtype)
+    b = jnp.asarray(rng.standard_normal((256, 128)) * 0.1, dtype)
+    one = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    split = (
+        jnp.dot(a[:, :128], b[:128], preferred_element_type=jnp.float32)
+        + jnp.dot(a[:, 128:], b[128:], preferred_element_type=jnp.float32)
+    )
+    return np.asarray(one.astype(dtype)), np.asarray(split.astype(dtype))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, BF16])
+def test_epsilon_admits_fold_order_perturbation(dtype):
+    ref, got = _two_fold_orders(dtype)
+    for family in ("ag_gemm", "gemm_rs", "flash_prefill"):
+        rep = epsilon.check_epsilon(ref, got, family)
+        assert rep["ok"], rep
+
+
+def test_epsilon_rejects_wrong_result():
+    """A dropped K block (half the sum missing) is a WRONG result, not
+    a reassociation — it must land far outside every family band."""
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.standard_normal((64, 256)) * 0.1, BF16)
+    b = jnp.asarray(rng.standard_normal((256, 128)) * 0.1, BF16)
+    ref = np.asarray(jnp.dot(a, b, preferred_element_type=jnp.float32)
+                     .astype(BF16))
+    dropped = np.asarray(
+        jnp.dot(a[:, :128], b[:128], preferred_element_type=jnp.float32)
+        .astype(BF16))
+    rep = epsilon.check_epsilon(ref, dropped, "ag_gemm")
+    assert not rep["ok"], rep
+    with pytest.raises(AssertionError, match="epsilon-band violation"):
+        epsilon.assert_epsilon(ref, dropped, "ag_gemm")
+
+
+def test_epsilon_band_unknown_family_falls_back_by_dtype():
+    band = epsilon.band_for("some_future_kernel", jnp.bfloat16)
+    assert band.cos == epsilon._DTYPE_FALLBACK["bfloat16"].cos
+    with pytest.raises(KeyError):
+        epsilon.band_for("some_future_kernel", jnp.int8)
+
+
+def test_epsilon_shape_mismatch_is_loud():
+    with pytest.raises(ValueError, match="shape mismatch"):
+        epsilon.drift(np.zeros((2, 2)), np.zeros((2, 3)))
+
+
+# -- parse_config ------------------------------------------------------------
+
+
+def test_parse_config_roundtrips_every_family():
+    from triton_dist_tpu.kernels import AgGemmConfig, GemmRsConfig
+    from triton_dist_tpu.kernels.flash_prefill import FlashPrefillConfig
+
+    for family, cfg in (
+        ("ag_gemm", AgGemmConfig(tile_m=64, tile_n=128, tile_k=256)),
+        ("gemm_rs", GemmRsConfig(tile_m_local=32, tile_n_local=128)),
+        ("flash_prefill", FlashPrefillConfig(block=64)),
+    ):
+        assert at.parse_config(family, repr(cfg)) == cfg
+
+
+def test_parse_config_is_loud_never_lenient():
+    with pytest.raises(ValueError):
+        at.parse_config("not_a_family", "AgGemmConfig(tile_m=8)")
+    with pytest.raises(ValueError):  # class/family mismatch
+        at.parse_config("ag_gemm", "GemmRsConfig(tile_m=8)")
+    with pytest.raises(ValueError):  # unknown field
+        at.parse_config("ag_gemm", "AgGemmConfig(bogus=1)")
+    with pytest.raises(ValueError):  # not a kwarg form (no eval here)
+        at.parse_config("ag_gemm", "AgGemmConfig(__import__('os'))")
+
+
+# -- TuneCache ---------------------------------------------------------------
+
+
+def _put_args(rig="cpu-world1"):
+    return ("ag_gemm", (32, 256, 256), "bfloat16", 1, "native", rig,
+            "AgGemmConfig(tile_m=8, tile_n=128, tile_k=128)")
+
+
+def test_cache_roundtrip(tmp_path):
+    p = str(tmp_path / "tc.json")
+    c = at.TuneCache(p)
+    c.put(*_put_args(), cost_ms=0.5, default_ms=1.0, round_=9)
+    c.save()
+    c2 = at.TuneCache(p)
+    e = c2.lookup("ag_gemm", (32, 256, 256), "bfloat16", 1, "native",
+                  "cpu-world1")
+    assert e is not None
+    assert e["config"] == "AgGemmConfig(tile_m=8, tile_n=128, tile_k=128)"
+    assert e["round"] == 9 and e["default_ms"] == 1.0
+
+
+def test_cache_same_rig_only():
+    """Measured beats modeled — but only on the rig that measured it."""
+    c = at.TuneCache()
+    c.put(*_put_args(rig="cpu-world1"), cost_ms=0.5)
+    assert c.lookup("ag_gemm", (32, 256, 256), "bfloat16", 1, "native",
+                    "v5p-world1") is None
+    assert c.lookup("ag_gemm", (32, 256, 256), "bfloat16", 2, "native",
+                    "cpu-world1") is None  # world is part of the key
+    assert c.lookup("ag_gemm", (32, 256, 256), "float32", 1, "native",
+                    "cpu-world1") is None  # dtype too
+
+
+def test_cache_corrupt_file_is_loud(tmp_path):
+    p = tmp_path / "tc.json"
+    p.write_text("{garbage")
+    with pytest.raises(ValueError, match="corrupt"):
+        at.TuneCache(str(p))
+    p.write_text(json.dumps({"version": 999, "entries": {}}))
+    with pytest.raises(ValueError, match="version"):
+        at.TuneCache(str(p))
+    p.write_text(json.dumps({"version": at.TUNE_CACHE_VERSION,
+                             "entries": {"not-json-list": {}}}))
+    with pytest.raises(ValueError, match="malformed key"):
+        at.TuneCache(str(p))
+    key = at.TuneCache.key("ag_gemm", (8,), "bfloat16", 1, "native", "r")
+    p.write_text(json.dumps({"version": at.TUNE_CACHE_VERSION,
+                             "entries": {key: {"cost_ms": 1}}}))
+    with pytest.raises(ValueError, match="malformed entry"):
+        at.TuneCache(str(p))
+
+
+def test_shape_bucket_rounds_leading_dim_only():
+    assert at.shape_bucket(100, 512, 384) == (128, 512, 384)
+    assert at.shape_bucket(64, 512, 384) == (64, 512, 384)
+    assert at.shape_bucket(1, 7) == (1, 7)
+
+
+def test_set_tune_cache_bumps_generation():
+    g0 = at.tune_cache_generation()
+    prev = at.set_tune_cache(at.TuneCache())
+    try:
+        assert at.tune_cache_generation() > g0
+    finally:
+        at.set_tune_cache(prev)
+
+
+# -- zero-risk off-switch: config=None is the legacy program -----------------
+
+
+def _mk(shape, dtype=BF16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape) * 0.1, dtype)
+
+
+def test_ag_gemm_config_none_is_bitwise_legacy(mesh2):
+    """config=None and the explicit default config compile the same
+    program: bitwise outputs, identical pallas_call_count."""
+    from triton_dist_tpu.kernels import AgGemmConfig, ag_gemm
+
+    x = _mk((64, 128))
+    w = _mk((128, 256), seed=1)
+
+    def run(cfg):
+        f = jax.jit(jax.shard_map(
+            lambda a, b: ag_gemm(a, b, axis="tp", config=cfg),
+            mesh=mesh2, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=P("tp"), check_vma=False))
+        n0 = lang_core.pallas_call_count()
+        out = np.asarray(f(x, w))
+        return out, lang_core.pallas_call_count() - n0
+
+    out_none, n_none = run(None)
+    out_dflt, n_dflt = run(AgGemmConfig())
+    np.testing.assert_array_equal(out_none, out_dflt)
+    assert n_none == n_dflt
+
+
+def test_gemm_rs_config_none_is_bitwise_legacy(mesh2):
+    from triton_dist_tpu.kernels import GemmRsConfig
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    a = _mk((64, 32))
+    b = _mk((32, 128), seed=1)
+
+    def run(cfg):
+        f = jax.jit(jax.shard_map(
+            lambda x, y: gemm_rs(x, y, axis="tp", config=cfg),
+            mesh=mesh2, in_specs=(P(None, "tp"), P("tp")),
+            out_specs=P("tp"), check_vma=False))
+        n0 = lang_core.pallas_call_count()
+        out = np.asarray(f(a, b))
+        return out, lang_core.pallas_call_count() - n0
+
+    out_none, n_none = run(None)
+    out_dflt, n_dflt = run(GemmRsConfig())
+    np.testing.assert_array_equal(out_none, out_dflt)
+    assert n_none == n_dflt
+
+
+def test_flash_prefill_block_none_is_bitwise_legacy():
+    from triton_dist_tpu.kernels.flash_prefill import flash_prefill_local
+
+    q = _mk((1, 64, 4, 64))
+    k = _mk((1, 128, 2, 64), seed=1)
+    v = _mk((1, 128, 2, 64), seed=2)
+
+    def run(block):
+        n0 = lang_core.pallas_call_count()
+        out = np.asarray(flash_prefill_local(q, k, v, block=block))
+        return out, lang_core.pallas_call_count() - n0
+
+    from triton_dist_tpu.kernels.flash_prefill import fit_block
+
+    out_none, n_none = run(None)
+    out_fit, n_fit = run(fit_block(128))
+    np.testing.assert_array_equal(out_none, out_fit)
+    assert n_none == n_fit
+
+
+def test_empty_cache_plan_applies_nothing(no_cache):
+    from triton_dist_tpu.models.config import ModelConfig
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = ModelConfig(
+        vocab_size=2048, hidden_size=512, intermediate_size=1024,
+        num_layers=2, num_q_heads=8, num_kv_heads=8, head_dim=64,
+        max_positions=256)
+    p = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    assert p.applied_configs() == {}
+    assert p.attn_block is None
+    assert all(d.applied_config == "" and d.config_source == ""
+               for d in p.decisions)
+    assert p.launch_config("mlp.ag") is None
+
+
+# -- apply path: a cached winner launches a different grid -------------------
+
+
+def test_ag_gemm_cached_winner_changes_launched_grid(mesh2):
+    """The acceptance pin: a non-default config produces a different
+    pallas grid than the default launch (last_launch hook), and the two
+    outputs agree under the epsilon band."""
+    from triton_dist_tpu.kernels import AgGemmConfig, ag_gemm
+    from triton_dist_tpu.kernels import allgather_gemm as agk
+
+    x = _mk((64, 128))
+    w = _mk((128, 256), seed=1)
+
+    def run(cfg):
+        f = jax.jit(jax.shard_map(
+            lambda a, b: ag_gemm(a, b, axis="tp", config=cfg,
+                                 force_kernel=True),
+            mesh=mesh2, in_specs=(P("tp"), P(None, "tp")),
+            out_specs=P("tp"), check_vma=False))
+        out = np.asarray(f(x, w))
+        return out, agk.last_launch()
+
+    out_dflt, ll_dflt = run(None)
+    tuned = AgGemmConfig(tile_m=8, tile_n=128, tile_k=64)
+    out_tuned, ll_tuned = run(tuned)
+    assert ll_dflt["path"] == ll_tuned["path"] == "pallas"
+    assert not ll_dflt["overridden"] and ll_tuned["overridden"]
+    assert ll_tuned["grid"] != ll_dflt["grid"], (ll_dflt, ll_tuned)
+    epsilon.assert_epsilon(out_dflt, out_tuned, "ag_gemm")
+
+
+def test_gemm_rs_cached_winner_changes_launched_grid(mesh2):
+    from triton_dist_tpu.kernels import GemmRsConfig
+    from triton_dist_tpu.kernels import gemm_reduce_scatter as rsk
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    a = _mk((64, 32))
+    b = _mk((32, 128), seed=1)
+
+    def run(cfg):
+        f = jax.jit(jax.shard_map(
+            lambda x, y: gemm_rs(x, y, axis="tp", config=cfg,
+                                 force_kernel=True),
+            mesh=mesh2, in_specs=(P(None, "tp"), P("tp")),
+            out_specs=P("tp"), check_vma=False))
+        out = np.asarray(f(a, b))
+        return out, rsk.last_launch()
+
+    out_dflt, ll_dflt = run(None)
+    out_tuned, ll_tuned = run(GemmRsConfig(tile_m=4))
+    assert not ll_dflt["overridden"] and ll_tuned["overridden"]
+    assert ll_tuned["tm"] != ll_dflt["tm"], (ll_dflt, ll_tuned)
+    epsilon.assert_epsilon(out_dflt, out_tuned, "gemm_rs")
+
+
+def test_flash_prefill_cached_block_changes_launched_fold():
+    from triton_dist_tpu.kernels import flash_prefill as fpk
+    from triton_dist_tpu.kernels.flash_prefill import flash_prefill_local
+
+    q = _mk((1, 64, 4, 64))
+    k = _mk((1, 128, 2, 64), seed=1)
+    v = _mk((1, 128, 2, 64), seed=2)
+
+    out_dflt = np.asarray(flash_prefill_local(q, k, v, block=None))
+    ll_dflt = fpk.last_launch()
+    out_tuned = np.asarray(flash_prefill_local(q, k, v, block=32))
+    ll_tuned = fpk.last_launch()
+    assert not ll_dflt["overridden"] and ll_tuned["overridden"]
+    assert ll_tuned["block"] == 32 and ll_tuned["block"] != ll_dflt["block"]
+    epsilon.assert_epsilon(out_dflt, out_tuned, "flash_prefill")
+
+
+# -- the planner consults the cache ------------------------------------------
+
+
+def _rig_model():
+    from triton_dist_tpu.models.config import ModelConfig
+
+    return ModelConfig(
+        vocab_size=2048, hidden_size=512, intermediate_size=1024,
+        num_layers=2, num_q_heads=8, num_kv_heads=8, head_dim=64,
+        max_positions=256)
+
+
+class _RecordingCache(at.TuneCache):
+    """Records every lookup key so tests can target the exact
+    (kernel, bucket, dtype, world, wire, rig) the planner queries."""
+
+    def __init__(self):
+        super().__init__()
+        self.queries = []
+
+    def lookup(self, *args):
+        self.queries.append(args)
+        return super().lookup(*args)
+
+
+def test_plan_inherits_cached_winner_and_restamps_plan_id():
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = _rig_model()
+    rec = _RecordingCache()
+    prev = at.set_tune_cache(rec)
+    try:
+        p0 = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+        assert p0.applied_configs() == {}
+        ag_queries = [q for q in rec.queries if q[0] == "ag_gemm"]
+        assert ag_queries, "planner never consulted the cache"
+        # seed a winner at the exact key the planner asked for
+        kernel, bucket, dtype, world, wire, rig = ag_queries[0]
+        cache = at.TuneCache()
+        cache.put(kernel, bucket, dtype, world, wire, rig,
+                  "AgGemmConfig(tile_m=8, tile_n=128, tile_k=64)",
+                  cost_ms=0.5, default_ms=1.0, round_=9)
+        at.set_tune_cache(cache)
+        p1 = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    finally:
+        at.set_tune_cache(prev)
+    applied = p1.applied_configs()
+    assert any(site.endswith(".ag") for site in applied), applied
+    site = next(s for s in applied if s.endswith(".ag"))
+    assert applied[site][1] == "cache"
+    lc = p1.launch_config(site)
+    assert (lc.tile_m, lc.tile_n, lc.tile_k) == (8, 128, 64)
+    # the winner is part of the plan identity (memo cannot mask it)
+    assert p1.plan_id != p0.plan_id
+    # routing itself is untouched — only the launch config changed
+    assert p1.fused_sites() == p0.fused_sites()
+    assert p1.mode == p0.mode
+
+
+def test_plan_inherits_cached_attn_block():
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = _rig_model()
+    rec = _RecordingCache()
+    prev = at.set_tune_cache(rec)
+    try:
+        plan_dense_forward(cfg, batch=1, seq=64, world=8)
+        fp_queries = [q for q in rec.queries if q[0] == "flash_prefill"]
+        assert fp_queries, "planner never consulted the flash cache"
+        kernel, bucket, dtype, world, wire, rig = fp_queries[0]
+        cache = at.TuneCache()
+        cache.put(kernel, bucket, dtype, world, wire, rig,
+                  "FlashPrefillConfig(block=32)", cost_ms=0.5, round_=9)
+        at.set_tune_cache(cache)
+        p1 = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    finally:
+        at.set_tune_cache(prev)
+    assert p1.attn_block == 32
+    assert p1.attn_block_source == "cache"
+    assert p1.applied_configs()["attn.core"] == (
+        "FlashPrefillConfig(block=32)", "cache")
+
+
+def test_stale_cache_entry_degrades_loudly_to_default():
+    """An entry today's code cannot parse warns and launches the
+    default — never a crash, never a silent wrong config."""
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = _rig_model()
+    rec = _RecordingCache()
+    prev = at.set_tune_cache(rec)
+    try:
+        plan_dense_forward(cfg, batch=1, seq=64, world=8)
+        kernel, bucket, dtype, world, wire, rig = [
+            q for q in rec.queries if q[0] == "ag_gemm"][0]
+        cache = at.TuneCache()
+        cache.put(kernel, bucket, dtype, world, wire, rig,
+                  "AgGemmConfig(renamed_field=8)", cost_ms=0.5)
+        at.set_tune_cache(cache)
+        with pytest.warns(UserWarning, match="tune-cache"):
+            p = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    finally:
+        at.set_tune_cache(prev)
+    assert p.applied_configs() == {}
+
+
+def test_plan_ep_chunks_consults_cache():
+    from triton_dist_tpu.plan.planner import plan_ep_chunks
+
+    rec = _RecordingCache()
+    prev = at.set_tune_cache(rec)
+    try:
+        n0 = plan_ep_chunks(m=256, hidden=128, inter=256, e_loc=2,
+                            n=4, top_k=2)
+        ep_queries = [q for q in rec.queries if q[0] == "ep_moe"]
+        assert ep_queries, "plan_ep_chunks never consulted the cache"
+        kernel, bucket, dtype, world, wire, rig = ep_queries[0]
+        cache = at.TuneCache()
+        cache.put(kernel, bucket, dtype, world, wire, rig,
+                  f"EpMoeConfig(n_chunks={n0 + 1})", cost_ms=0.5)
+        at.set_tune_cache(cache)
+        n1 = plan_ep_chunks(m=256, hidden=128, inter=256, e_loc=2,
+                            n=4, top_k=2)
+    finally:
+        at.set_tune_cache(prev)
+    assert n1 == n0 + 1
+
+
+# -- execute threads applied configs into the layer calls --------------------
+
+
+def test_execute_threads_attn_block_into_flash_launch(mesh2):
+    """End to end through plan/execute: a Plan carrying a tune-cache
+    attn_block launches the flash fold at that block."""
+    import dataclasses
+
+    from triton_dist_tpu.kernels import flash_prefill as fpk
+    from triton_dist_tpu.layers import TPAttnParams, TPAttnSpec
+    from triton_dist_tpu.plan.execute import attn_fwd
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = _rig_model()
+    prev = at.set_tune_cache(at.TuneCache())
+    try:
+        plan = plan_dense_forward(cfg, batch=1, seq=64, world=8,
+                                  mode="xla", attn_impl="pallas")
+    finally:
+        at.set_tune_cache(prev)
+    plan = dataclasses.replace(plan, attn_block=32,
+                               attn_block_source="cache")
+
+    hq_l, hkv_l, d = 1, 1, 64  # per-rank head geometry on the 8-way mesh
+    spec = TPAttnSpec(hq_l, hkv_l, d)
+    h = 512
+    m = 64
+    x = _mk((m, h))
+    params = TPAttnParams(
+        w_qkv=_mk((h, (hq_l + 2 * hkv_l) * d), seed=1),
+        w_o=_mk((hq_l * d, h), seed=2))
+    cos = _mk((256, d // 2), jnp.float32, seed=3)
+    sin = _mk((256, d // 2), jnp.float32, seed=4)
+    positions = jnp.broadcast_to(jnp.arange(m)[None, :], (1, m))
+
+    def per_rank(x):
+        y, _ = attn_fwd(plan, x, params, spec, cos, sin, positions,
+                        batch=1, axis="tp", kv_cache=None, kv_len=None)
+        return y
+
+    f = jax.jit(jax.shard_map(
+        per_rank, mesh=mesh2, in_specs=P("tp"), out_specs=P("tp"),
+        check_vma=False))
+    np.asarray(f(jnp.concatenate([x] * 1, axis=0)))
+    ll = fpk.last_launch()
+    assert ll is not None and ll["block"] == 32 and ll["overridden"]
+
+
+def test_plan_memo_sees_cache_generation(no_cache):
+    """plan_dense_forward's lru memo keys on the tune-cache generation:
+    a plan built before the cache is populated never masks the winner."""
+    from triton_dist_tpu.plan.planner import plan_dense_forward
+
+    cfg = _rig_model()
+    p0 = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    rec = _RecordingCache()
+    at.set_tune_cache(rec)
+    plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    kernel, bucket, dtype, world, wire, rig = [
+        q for q in rec.queries if q[0] == "ag_gemm"][0]
+    cache = at.TuneCache()
+    cache.put(kernel, bucket, dtype, world, wire, rig,
+              "AgGemmConfig(tile_m=8, tile_n=128, tile_k=64)",
+              cost_ms=0.5)
+    at.set_tune_cache(cache)
+    p1 = plan_dense_forward(cfg, batch=1, seq=64, world=8)
+    assert p1.plan_id != p0.plan_id
+    assert p1.applied_configs() != {}
+
+
+# -- the committed cache & its CI gate ---------------------------------------
+
+
+def test_check_tune_cache_cli_polarity(tmp_path):
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_tc_cli", os.path.join(repo, "scripts", "check_tune_cache.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    good = tmp_path / "good.json"
+    c = at.TuneCache(str(good))
+    c.put("ag_gemm", (32, 256, 256), "bfloat16", 1, "native",
+          "cpu-world1", "AgGemmConfig(tile_m=8, tile_n=128, tile_k=128)",
+          cost_ms=0.5, round_=9)
+    c.save()
+    assert cli.main([str(good)]) == 0
+
+    bad = tmp_path / "bad.json"
+    c = at.TuneCache(str(bad))
+    c.put("ag_gemm", (8192, 8192, 8192), "bfloat16", 1, "native",
+          "cpu-world1",
+          "AgGemmConfig(tile_m=8192, tile_n=8192, tile_k=8192)",
+          cost_ms=0.5, round_=9)
+    c.save()
+    assert cli.main([str(bad)]) == 1
+
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{nope")
+    assert cli.main([str(corrupt)]) == 1
+
+    assert cli.main([str(tmp_path / "absent.json")]) == 0
+
+
+def test_committed_cache_if_present_is_valid():
+    """Whatever TUNE_CACHE.json is committed must pass the same gate
+    CI runs — a PR that stales the cache fails here too."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "TUNE_CACHE.json")
+    if not os.path.exists(path):
+        pytest.skip("no committed tune cache")
+    spec = importlib.util.spec_from_file_location(
+        "_tc_cli2", os.path.join(repo, "scripts", "check_tune_cache.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    assert cli.main([path]) == 0

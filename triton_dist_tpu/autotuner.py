@@ -25,6 +25,7 @@ import dataclasses
 import enum
 import json
 import os
+import re
 import statistics
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence
 
@@ -213,6 +214,238 @@ def get_tuner(name: str) -> ContextualAutotuner:
     return _TUNERS[name]
 
 
+# -- persistent tune cache (measured winners the planner launches) -----------
+#
+# ContextualAutotuner above caches (name, key) -> winner for ONE process
+# re-running the same tuned thunk. TuneCache is the cross-process half of
+# the loop: bench.py's sweep arms write the measured winner per
+# (kernel, shape-bucket, dtype, world, wire, rig), and plan_forward
+# consults it BEFORE the model-ranked frontier — a measured result on the
+# same rig beats a modeled one; a different rig's measurement is never
+# trusted (the key carries the rig, so cross-rig hits cannot happen).
+# Every entry stamps the producing artifact round, so a cached config is
+# traceable to the BENCH_r*.json that measured it.
+
+TUNE_CACHE_VERSION = 1
+TUNE_CACHE_BASENAME = "TUNE_CACHE.json"
+
+# kernel family -> the config dataclass its cached reprs parse into
+# (parse_config). gemm_ar rides GemmRsConfig (the fused reduction takes
+# the same config object); the EP plane caches a whole EpMoeConfig and
+# consumers read .n_chunks.
+_CONFIG_CLASS_OF = {
+    "ag_gemm": ("triton_dist_tpu.kernels.allgather_gemm", "AgGemmConfig"),
+    "ag_group_gemm": ("triton_dist_tpu.kernels.allgather_gemm",
+                      "AgGemmConfig"),
+    "gemm_rs": ("triton_dist_tpu.kernels.gemm_reduce_scatter",
+                "GemmRsConfig"),
+    "gemm_ar": ("triton_dist_tpu.kernels.gemm_reduce_scatter",
+                "GemmRsConfig"),
+    "moe_reduce_rs": ("triton_dist_tpu.kernels.gemm_reduce_scatter",
+                      "GemmRsConfig"),
+    "flash_prefill": ("triton_dist_tpu.kernels.flash_prefill",
+                      "FlashPrefillConfig"),
+    "ep_moe": ("triton_dist_tpu.kernels.ep_a2a", "EpMoeConfig"),
+}
+
+_CONFIG_KV_RE = re.compile(r"^\s*(\w+)\s*=\s*(-?\d+\.?\d*|True|False)\s*$")
+
+
+def rig_name(chip=None, world: int = 1) -> str:
+    """THE rig string tune-cache entries are keyed and looked up by:
+    bench.py's cpu rig stamps its artifact `rig: "cpu-world1"` through
+    this same formula, so a planner lookup on the measuring machine at
+    the measured world hits, and nothing else does (same-rig-only by
+    construction)."""
+    chip = chip or detect_chip()
+    return f"{chip.name}-world{int(world)}"
+
+
+def shape_bucket(*dims) -> tuple:
+    """Cache-key shape bucket: the leading (token/row) dim rounds up to
+    the next power of two — serving batches wander, weight geometry does
+    not — and every trailing dim (hidden/heads/inter) stays exact."""
+    if not dims:
+        return ()
+    m = max(int(dims[0]), 1)
+    return ((1 << (m - 1).bit_length()),) + tuple(int(x) for x in dims[1:])
+
+
+def _dtype_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).name
+
+
+def parse_config(kernel: str, text: str):
+    """Parse a cached config repr ("AgGemmConfig(tile_m=256, ...)") back
+    into the kernel family's config dataclass — a constrained kwarg
+    parser, NOT eval: only `name=<int|float|bool>` pairs are accepted and
+    only fields the dataclass defines are kept. Raises ValueError on
+    anything else (the cache validators want corrupt entries loud)."""
+    import importlib
+
+    if kernel not in _CONFIG_CLASS_OF:
+        raise ValueError(f"no config class for kernel family {kernel!r}")
+    mod_name, cls_name = _CONFIG_CLASS_OF[kernel]
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    text = text.strip()
+    if not (text.startswith(cls_name + "(") and text.endswith(")")):
+        raise ValueError(
+            f"cached {kernel} config {text!r} is not a {cls_name} repr")
+    body = text[len(cls_name) + 1:-1].strip()
+    fields = {f.name: f.type for f in dataclasses.fields(cls)}
+    kw = {}
+    for part in filter(None, (p.strip() for p in body.split(","))):
+        m = _CONFIG_KV_RE.match(part)
+        if not m:
+            raise ValueError(
+                f"cached {kernel} config {text!r}: unparseable field "
+                f"{part!r}")
+        name, val = m.group(1), m.group(2)
+        if name not in fields:
+            raise ValueError(
+                f"cached {kernel} config {text!r}: unknown field "
+                f"{name!r}")
+        kw[name] = (val == "True" if val in ("True", "False")
+                    else float(val) if "." in val else int(val))
+    return cls(**kw)
+
+
+class TuneCache:
+    """On-disk JSON table of measured tuning winners.
+
+    {"version": 1, "entries": {key: entry}} where key is the JSON list
+    [kernel, shape_bucket, dtype, world, wire, rig] and entry carries
+    {"config": repr, "cost_ms", "default_ms", "round"}. Loading a
+    corrupt or wrong-version file raises ValueError LOUDLY — a planner
+    silently launching default tiles because the cache rotted is exactly
+    the un-observable regression this file exists to prevent
+    (scripts/check_tune_cache.py gates the committed copy in CI)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.entries: Dict[str, dict] = {}
+        if path and os.path.exists(path):
+            self.load()
+
+    @staticmethod
+    def key(kernel: str, bucket, dtype, world: int, wire: Optional[str],
+            rig: str) -> str:
+        return json.dumps([kernel, list(bucket), _dtype_name(dtype),
+                           int(world), wire or "native", rig])
+
+    def load(self) -> None:
+        with open(self.path) as f:
+            try:
+                disk = json.load(f)
+            except ValueError as e:
+                raise ValueError(
+                    f"tune cache {self.path} is corrupt JSON: {e}") from e
+        if not isinstance(disk, dict) \
+                or disk.get("version") != TUNE_CACHE_VERSION:
+            raise ValueError(
+                f"tune cache {self.path} has version "
+                f"{disk.get('version') if isinstance(disk, dict) else '?'}"
+                f", expected {TUNE_CACHE_VERSION}")
+        entries = disk.get("entries")
+        if not isinstance(entries, dict):
+            raise ValueError(f"tune cache {self.path} has no entries table")
+        for k, v in entries.items():
+            try:
+                parts = json.loads(k)
+            except ValueError:
+                parts = None
+            if not (isinstance(parts, list) and len(parts) == 6):
+                raise ValueError(
+                    f"tune cache {self.path}: malformed key {k!r}")
+            if not (isinstance(v, dict) and isinstance(v.get("config"), str)
+                    and isinstance(v.get("cost_ms"), (int, float))
+                    and isinstance(v.get("round"), int)):
+                raise ValueError(
+                    f"tune cache {self.path}: malformed entry for {k!r}")
+        self.entries = entries
+        _bump_tune_generation()
+
+    def save(self) -> None:
+        if not self.path:
+            raise ValueError("TuneCache has no path to save to")
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": TUNE_CACHE_VERSION,
+                       "entries": self.entries}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, self.path)
+
+    def put(self, kernel: str, bucket, dtype, world: int,
+            wire: Optional[str], rig: str, config, cost_ms: float,
+            default_ms: Optional[float] = None,
+            round_: int = 0) -> None:
+        self.entries[self.key(kernel, bucket, dtype, world, wire, rig)] = {
+            "config": config if isinstance(config, str) else repr(config),
+            "cost_ms": round(float(cost_ms), 6),
+            "default_ms": (None if default_ms is None
+                           else round(float(default_ms), 6)),
+            "round": int(round_),
+        }
+        _bump_tune_generation()
+
+    def lookup(self, kernel: str, bucket, dtype, world: int,
+               wire: Optional[str], rig: str) -> Optional[dict]:
+        return self.entries.get(
+            self.key(kernel, bucket, dtype, world, wire, rig))
+
+
+_ACTIVE_TUNE_CACHE: Optional[TuneCache] = None
+_TUNE_GENERATION = 0
+
+
+def _bump_tune_generation() -> None:
+    global _TUNE_GENERATION
+    _TUNE_GENERATION += 1
+
+
+def tune_cache_generation() -> int:
+    """Monotone counter bumped on every cache load/put/swap — plan
+    memoization keys include it, so a plan built before the cache was
+    populated never masks a later measured winner."""
+    return _TUNE_GENERATION
+
+
+def default_tune_cache_path() -> Optional[str]:
+    """TDT_TUNE_CACHE env wins (set-but-EMPTY pins the empty in-memory
+    cache — tests/conftest.py uses this so tier-1 behavior never
+    depends on what a bench round committed); else the repo-root
+    TUNE_CACHE.json when present; else None (empty in-memory cache)."""
+    p = os.environ.get("TDT_TUNE_CACHE")
+    if p is not None:
+        return p or None
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = os.path.join(root, TUNE_CACHE_BASENAME)
+    return p if os.path.exists(p) else None
+
+
+def active_tune_cache() -> TuneCache:
+    global _ACTIVE_TUNE_CACHE
+    if _ACTIVE_TUNE_CACHE is None:
+        _ACTIVE_TUNE_CACHE = TuneCache(default_tune_cache_path())
+    return _ACTIVE_TUNE_CACHE
+
+
+def set_tune_cache(cache: Optional[TuneCache]) -> Optional[TuneCache]:
+    """Install `cache` as the process-wide planner cache (tests; bench
+    arms). Returns the previous cache so callers can restore it."""
+    global _ACTIVE_TUNE_CACHE
+    prev = _ACTIVE_TUNE_CACHE
+    _ACTIVE_TUNE_CACHE = cache
+    _bump_tune_generation()
+    return prev
+
+
 def ag_gemm_config_space():
     """Candidate AgGemmConfig grid for the contextual tuner (the reference
     folds these into its context factories; ours ship a measured default
@@ -264,6 +497,75 @@ def gemm_rs_local_config_space():
 # -- model-pruned candidate sets (perf_model roofline pre-filter) -----------
 
 
+def _blocked_vmem_need(cfg, m, n, k, attr_names, dtype, out_dtype):
+    """VMEM a blocked-GEMM config needs at (m, n, k) after tile fitting —
+    THE formula `_prune_blocked_configs` prunes with and the launch-time
+    re-validators (ag_gemm_config_fits / gemm_rs_local_config_fits) gate
+    with, so a cached config is rejected by exactly the accounting that
+    admitted it."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.lang.core import fit_tile
+
+    isz = jnp.dtype(dtype or jnp.bfloat16).itemsize
+    osz = jnp.dtype(out_dtype or dtype or jnp.bfloat16).itemsize
+    am, an, ak = attr_names
+    tm = fit_tile(getattr(cfg, am), m)
+    tn = fit_tile(getattr(cfg, an), n)
+    tk = fit_tile(getattr(cfg, ak), k)
+    need = 2 * (tm * tk + tk * tn) * isz + 2 * tm * tn * osz
+    if -(-k // tk) > 1:
+        need += tm * tn * 4  # f32 accumulator (skipped at nk==1)
+    return need
+
+
+def ag_gemm_config_fits(cfg, m, k, n_loc, dtype=None, out_dtype=None,
+                        chip=None) -> bool:
+    """Launch-time fit gate for a tuned/cached AgGemmConfig: its fitted
+    tiles at this shape stay under the forced-kernel VMEM ceiling (the
+    same budget the pruner admitted it against)."""
+    from triton_dist_tpu.kernels.allgather_gemm import AgGemmConfig
+    from triton_dist_tpu.perf_model import kernel_vmem_ceiling
+
+    budget = max(AgGemmConfig().vmem_budget, kernel_vmem_ceiling(chip))
+    return _blocked_vmem_need(cfg, m, n_loc, k,
+                              ("tile_m", "tile_n", "tile_k"),
+                              dtype, out_dtype) <= budget
+
+
+def gemm_rs_local_config_fits(cfg, m, k_loc, n_full, dtype=None,
+                              out_dtype=None, chip=None) -> bool:
+    """Launch-time fit gate for a tuned/cached GemmRsConfig's local
+    (blocked-matmul) tiles — the regime the world=1 sweeps measure."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import GemmRsConfig
+    from triton_dist_tpu.perf_model import kernel_vmem_ceiling
+
+    budget = max(GemmRsConfig().vmem_budget, kernel_vmem_ceiling(chip))
+    return _blocked_vmem_need(
+        cfg, m, n_full, k_loc,
+        ("tile_m_local", "tile_n_local", "tile_k_local"),
+        dtype, out_dtype) <= budget
+
+
+def flash_prefill_config_fits(cfg, s_q, t, hq, hkv, d, dtype=None,
+                              batch=1, chip=None) -> bool:
+    """Launch-time fit gate for a tuned/cached FlashPrefillConfig: the
+    fitted KV page (the kernel's own fit_block divisor rule) stays under
+    the VMEM ceiling."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.flash_prefill import (
+        fit_block,
+        flash_prefill_vmem_bytes,
+    )
+    from triton_dist_tpu.perf_model import kernel_vmem_ceiling
+
+    block = cfg if isinstance(cfg, int) else cfg.block
+    need = flash_prefill_vmem_bytes(s_q, hq, hkv, d, fit_block(t, block),
+                                    dtype or jnp.bfloat16, batch=batch)
+    return need <= kernel_vmem_ceiling(chip)
+
+
 def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
                            dtype, out_dtype, vmem_budget, slack, chip,
                            top_n):
@@ -297,8 +599,6 @@ def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
     from triton_dist_tpu.perf_model import kernel_vmem_ceiling
 
     dtype = dtype or jnp.bfloat16
-    isz = jnp.dtype(dtype).itemsize
-    osz = jnp.dtype(out_dtype or dtype).itemsize
     budget = vmem_budget or max(default_budget,
                                 kernel_vmem_ceiling(chip))
     am, an, ak = attr_names
@@ -309,11 +609,8 @@ def _prune_blocked_configs(m, n, k, configs, attr_names, default_budget,
                 fit_tile(getattr(cfg, ak), k))
 
     def vmem_need(cfg):
-        tm, tn, tk = fitted(cfg)
-        need = 2 * (tm * tk + tk * tn) * isz + 2 * tm * tn * osz
-        if -(-k // tk) > 1:
-            need += tm * tn * 4  # f32 accumulator (skipped at nk==1)
-        return need
+        return _blocked_vmem_need(cfg, m, n, k, attr_names, dtype,
+                                  out_dtype)
 
     live = [c for c in configs if vmem_need(c) <= budget]
     if not live:

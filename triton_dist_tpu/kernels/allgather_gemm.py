@@ -460,6 +460,18 @@ def _ag_gemm_kernel(axis: str, n: int, mt: int, nt: int, nk: int,
             st.wait()
 
 
+# trace-time record of the most recent ag_gemm lowering decision — the
+# fitted tiles and pallas grid that actually launched (or "xla" when the
+# call fell back). Debug/test hook in the last_regime() idiom
+# (gemm_reduce_scatter.py): tests pin that a tune-cache winner changes
+# the launched grid without reverse-engineering the jaxpr.
+_last_launch = None
+
+
+def last_launch():
+    return _last_launch
+
+
 def arrival_to_rank_order(c, axis: str):
     """Permute an arrival-order C (ring-step-major row blocks: block s
     holds global chunk (me - s) mod n) back to global rank order."""
@@ -522,6 +534,9 @@ def ag_gemm(
     tile-store instants); fallback paths return an empty buffer.
     """
     cfg = config or AgGemmConfig()
+    global _last_launch
+    _last_launch = {"kernel": "ag_gemm", "path": "xla",
+                    "overridden": config is not None}
     build = trace_ev.active_build()
     gbuild = _guard.active_build()
     obuild = _obs.active_build()
@@ -675,6 +690,9 @@ def ag_gemm(
 
     need_ws = n > 1 or return_gathered
     grid = (n, mt, nt, nk)
+    _last_launch = {"kernel": "ag_gemm", "path": "pallas",
+                    "tm": tm, "tn": tn, "tk": tk, "grid": grid,
+                    "overridden": config is not None}
     if grouped:
         b_spec = pl.BlockSpec(
             (1, tk, tn),

@@ -287,6 +287,17 @@ def _fp_local_kernel(hq, hkv, d, s, t, blk, causal, scale,
     o_ref[0] = _finalize(list(states)).astype(o_ref.dtype)
 
 
+# Trace-time record of the most recent flash_prefill_local lowering —
+# the fitted KV page height and grid (last_regime()/last_launch()
+# idiom): tests pin that a tune-cache attn_block changes the launched
+# fold without reverse-engineering the jaxpr.
+_last_launch = None
+
+
+def last_launch():
+    return _last_launch
+
+
 def flash_prefill_local(
     q: jax.Array,  # (B, S, Hq, D)
     k: jax.Array,  # (B, T, Hkv, D)
@@ -303,11 +314,15 @@ def flash_prefill_local(
     double-buffered (block, Hkv*D) pages so the (S, T) logits tensor
     never exists — peak memory O(S*block). Returns (B, S, Hq, D) in
     q.dtype."""
+    global _last_launch
     b, s, hq, d = q.shape
     _, t, hkv, _ = k.shape
     w = hkv * d
     scale = float(scale if scale is not None else d ** -0.5)
     blk = int(block or _kv_block(t))
+    _last_launch = {"kernel": "flash_prefill", "path": "local",
+                    "block": blk, "grid": (b,),
+                    "overridden": block is not None}
     t_valid = t
     if t % blk:
         pad = blk - t % blk

@@ -359,6 +359,16 @@ def last_regime():
     return _last_regime
 
 
+# Trace-time record of the most recent gemm_rs lowering's fitted tiles
+# and pallas grid (same idiom; "path" mirrors the regime). Tests pin
+# that a tune-cache winner changes the launched grid.
+_last_launch = None
+
+
+def last_launch():
+    return _last_launch
+
+
 def gemm_rs(
     a: jax.Array,
     b: jax.Array,
@@ -391,8 +401,10 @@ def gemm_rs(
     ring regimes' device trace buffer (credit/hop waits vs partial-GEMM
     spans); local_mm/xla paths return an empty buffer.
     """
-    global _last_regime
+    global _last_regime, _last_launch
     cfg = config or GemmRsConfig()
+    _last_launch = {"kernel": "gemm_rs", "path": "xla",
+                    "overridden": config is not None}
     out_dtype = out_dtype or a.dtype
     assert a_order in ("rank", "arrival"), a_order
     a_arrival = a_order == "arrival"
@@ -515,6 +527,8 @@ def gemm_rs(
 
     if vmem_resident <= cfg.vmem_budget:
         _last_regime = "resident"
+        _last_launch = {"kernel": "gemm_rs", "path": "resident",
+                        "tm": tm, "overridden": config is not None}
         return _ring_call(
             functools.partial(_gemm_rs_kernel, axis, n, tm, out_dtype,
                               (cfg.straggler_rank, cfg.straggler_ns),
@@ -551,6 +565,8 @@ def gemm_rs(
         tn = tn_cands[-1]  # forced: smallest tile, budget overridden below
     if n > 1 and tn is not None:
         _last_regime = "streamed"
+        _last_launch = {"kernel": "gemm_rs", "path": "streamed",
+                        "tn": tn, "overridden": config is not None}
         return _ring_call(
             functools.partial(
                 _gemm_rs_kernel_streamed, axis, n, tn, out_dtype,
@@ -601,6 +617,10 @@ def gemm_rs(
         vmem_local = 2 * (tm_l * tk_l + tk_l * tn_l) * in_itemsize \
             + 2 * tm_l * tn_l * out_itemsize \
             + (tm_l * tn_l * 4 if nk > 1 else 0)
+        _last_launch = {"kernel": "gemm_rs", "path": "local_mm",
+                        "tm": tm_l, "tn": tn_l, "tk": tk_l,
+                        "grid": (m // tm_l, n_full // tn_l, nk),
+                        "overridden": config is not None}
         return with_trace(tpu_call(
             functools.partial(_local_mm_kernel, nk, out_dtype),
             grid=(m // tm_l, n_full // tn_l, nk),

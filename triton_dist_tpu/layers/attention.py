@@ -147,6 +147,7 @@ def gqa_attention(
     kv_len: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     prefill_impl: Optional[str] = None,
+    prefill_block: Optional[int] = None,
 ):
     """Grouped-query attention forward.
 
@@ -158,14 +159,17 @@ def gqa_attention(
     prefill implementation ("xla" | "pallas" — the serve prefill-chunk
     switch; None = auto routing: the Pallas flash kernel whenever the
     native gate + perf model pick it, the blockwise scan past
-    _BLOCKWISE_T, the dense einsum chain otherwise). Returns
-    (B, S, Hq, D) in q.dtype.
+    _BLOCKWISE_T, the dense einsum chain otherwise). prefill_block:
+    override the blockwise KV page height (the planner's tune-cache
+    attn_block; None keeps the 512 default, so an empty cache compiles
+    exactly the legacy program). Returns (B, S, Hq, D) in q.dtype.
     """
     b, s, hq, d = q.shape
     _, t, hkv, _ = k.shape
     if s > 1:
         impl = (prefill_impl if prefill_impl is not None
                 else _route_prefill_impl(b, s, t, hq, hkv, d, k.dtype))
+        blk = {} if prefill_block is None else {"chunk": int(prefill_block)}
         if impl == "pallas":
             # serve prefill-chunk / native prefill: the Pallas kernel
             # beats the dense chain as soon as the f32 logits tensor
@@ -173,7 +177,7 @@ def gqa_attention(
             return gqa_attention_blockwise(
                 q, k, v, causal=causal, q_offset=q_offset,
                 q_positions=q_positions, kv_len=kv_len, scale=scale,
-                impl="pallas",
+                impl="pallas", **blk,
             )
         if t >= _BLOCKWISE_T:
             # long-context prefill: O(S*chunk) blockwise path (decode
@@ -181,7 +185,7 @@ def gqa_attention(
             return gqa_attention_blockwise(
                 q, k, v, causal=causal, q_offset=q_offset,
                 q_positions=q_positions, kv_len=kv_len, scale=scale,
-                impl="xla",
+                impl="xla", **blk,
             )
     g = hq // hkv
     scale = scale if scale is not None else d ** -0.5

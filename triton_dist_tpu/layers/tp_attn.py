@@ -75,18 +75,21 @@ def _qk_norm_rope(q, k, params: TPAttnParams, cos, sin, positions):
 
 
 def _attn_core(qkv, params, spec, batch, cos, sin, positions, kv_cache,
-               kv_len, attn_impl=None):
+               kv_len, attn_impl=None, attn_block=None):
     """Shared middle: split + qknorm + rope + (cached) attention.
 
     attn_impl: forwarded to gqa_attention's prefill_impl — the serve
     prefill-chunk / blockwise-prefill switch ("xla" | "pallas" | None =
-    auto; kernels/flash_prefill.py). Returns (attn_out (M, Hq*D),
-    new_kv_cache)."""
+    auto; kernels/flash_prefill.py). attn_block: forwarded to
+    gqa_attention's prefill_block — the planner's tune-cache KV page
+    height (None keeps the default block, i.e. the legacy program).
+    Returns (attn_out (M, Hq*D), new_kv_cache)."""
     q, k, v = _split_qkv(qkv, spec, batch)
     q, k = _qk_norm_rope(q, k, params, cos, sin, positions)
     if kv_cache is None:
         out = gqa_attention(q, k, v, causal=True,
-                            prefill_impl=attn_impl)
+                            prefill_impl=attn_impl,
+                            prefill_block=attn_block)
         new_cache = (k, v)
     else:
         assert kv_len is not None, (
@@ -102,6 +105,7 @@ def _attn_core(qkv, params, spec, batch, cos, sin, positions, kv_cache,
         out = gqa_attention(
             q, k_cache, v_cache, causal=True, q_positions=positions,
             kv_len=kv_len, prefill_impl=attn_impl,
+            prefill_block=attn_block,
         )
         new_cache = (k_cache, v_cache)
     m = out.shape[0] * out.shape[1]
@@ -116,13 +120,15 @@ def _scatter_kv(cache, kv, positions):
 
 def tp_attn_xla_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
                     cos, sin, positions, batch: int, axis: str = TP_AXIS,
-                    kv_cache=None, kv_len=None, attn_impl=None):
+                    kv_cache=None, kv_len=None, attn_impl=None,
+                    attn_block=None):
     """Unfused parity path (ref torch_fwd, tp_attn.py:180)."""
     x_full = jax.lax.all_gather(x_shard, axis, tiled=True)
     qkv = jnp.dot(x_full, params.w_qkv,
                   preferred_element_type=jnp.float32).astype(x_shard.dtype)
     out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
-                                positions, kv_cache, kv_len, attn_impl)
+                                positions, kv_cache, kv_len, attn_impl,
+                                attn_block)
     partial = jnp.dot(out, params.w_o, preferred_element_type=jnp.float32)
     y = jax.lax.psum_scatter(
         partial.astype(x_shard.dtype), axis, tiled=True
@@ -133,6 +139,7 @@ def tp_attn_xla_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
 def tp_attn_dist_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
                      cos, sin, positions, batch: int, axis: str = TP_AXIS,
                      kv_cache=None, kv_len=None, attn_impl=None,
+                     attn_block=None,
                      ag_config: Optional[AgGemmConfig] = None,
                      rs_config: Optional[GemmRsConfig] = None):
     """Fused path (ref dist_triton_fwd, tp_attn.py:215): overlapped
@@ -145,7 +152,8 @@ def tp_attn_dist_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
     qkv = primary(ag_gemm(x_shard, params.w_qkv, axis=axis,
                           config=ag_config))
     out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
-                                positions, kv_cache, kv_len, attn_impl)
+                                positions, kv_cache, kv_len, attn_impl,
+                                attn_block)
     y = primary(gemm_rs(out, params.w_o, axis=axis, config=rs_config))
     return y, new_cache
 
@@ -153,13 +161,15 @@ def tp_attn_dist_fwd(x_shard, params: TPAttnParams, spec: TPAttnSpec,
 def tp_attn_ar_fwd(x_full, params: TPAttnParams, spec: TPAttnSpec,
                    cos, sin, positions, batch: int, axis: str = TP_AXIS,
                    kv_cache=None, kv_len=None, attn_impl=None,
+                   attn_block=None,
                    rs_config: Optional[GemmRsConfig] = None):
     """Replicated-activation path (ref AR fwd modes, tp_attn.py:254-330):
     local QKV gemm, attention, fused gemm+allreduce O projection."""
     qkv = jnp.dot(x_full, params.w_qkv,
                   preferred_element_type=jnp.float32).astype(x_full.dtype)
     out, new_cache = _attn_core(qkv, params, spec, batch, cos, sin,
-                                positions, kv_cache, kv_len, attn_impl)
+                                positions, kv_cache, kv_len, attn_impl,
+                                attn_block)
     y = gemm_ar(out, params.w_o, axis=axis, config=rs_config)
     return y, new_cache
 

@@ -682,7 +682,10 @@ def schedule_graph(
     plan (optional triton_dist_tpu.plan.Plan): the fusion plan this
     graph was lowered under — the schedule adopts its mega_strategy and
     carries its plan_id, so the megakernel and the layer-forward planes
-    provably run the SAME pairing decisions."""
+    provably run the SAME pairing decisions. The plan_id hashes the
+    plan's applied tune-cache winners (Plan.applied_configs) along with
+    the routing, so a schedule built before the cache was populated can
+    never be confused with one inheriting a measured config."""
     n = len(graph.tasks)
     if n == 0:
         raise ValueError("empty megakernel graph")

@@ -119,6 +119,16 @@ ACKNOWLEDGED = {
     # the round-7 ("sp_prefill_vs_ring", "trend_regression") ack was
     # deleted in round 8: r08 measured the arm back inside tolerance,
     # turning the entry into a stale_ack note (the series recovered)
+    ("plan_decode_ms", "trend_regression"): (
+        "2-core rig-local absolute arm, not a planner change: r09 "
+        "read the planned decode step at 11.4ms vs the 7.5-8.8ms of "
+        "r07/r08 while the SAME-RUN hand-routed denominator moved "
+        "with it (plan_vs_hand_decode 0.79, the best ratio of the "
+        "series — a slow machine, not a slow plan; the r09 routing "
+        "is byte-identical to r08's committed PLAN_TABLE.json, "
+        "plan_report --diff 0 flips). The cpu-world1 rig only claims "
+        "ratios (docs/performance.md 'Rigs'); the trend re-arms on "
+        "the next artifact inside tolerance."),
 }
 
 

@@ -18,6 +18,16 @@ Unknown triples never reach here: the planner already lowered them
 sequentially (loudly), so execution only ever sees mode strings the
 layer registries define — an unplanned mode string is a KeyError at
 trace time, not a silent wrong kernel.
+
+Tuned launches: when a TripleDecision carries an applied_config (a
+MEASURED tune-cache winner; planner module doc), `_site_configs`
+threads it into the layer call as the kernel `config=` kwarg — the dist
+lowering takes both ag_config and rs_config, the ar lowering only
+rs_config (its gather is the kernel-internal allreduce), the xla
+lowering none (no pallas kernels to configure). An empty cache leaves
+every applied_config blank, all kwargs stay None, and the compiled
+program is byte-for-byte the legacy one (the zero-risk off-switch,
+pinned in tests/test_tuning_loop.py).
 """
 
 from __future__ import annotations
@@ -48,24 +58,48 @@ def gather_tokens(x: jax.Array, axis: str, plan: Plan) -> jax.Array:
     return jax.lax.all_gather(x, axis, tiled=True)
 
 
+def _site_configs(plan: Plan, mode: str, ag_site: str, rs_site: str) -> dict:
+    """Kwargs threading the plan's applied tune-cache winners into a
+    layer call — only the kwargs the `mode` lowering accepts, only when
+    the decision actually carries a winner (empty cache => {})."""
+    kw = {}
+    if mode in ("dist",):
+        cfg = plan.launch_config(ag_site)
+        if cfg is not None:
+            kw["ag_config"] = cfg
+    if mode in ("dist", "ar"):
+        cfg = plan.launch_config(rs_site)
+        if cfg is not None:
+            kw["rs_config"] = cfg
+    return kw
+
+
 def attn_fwd(plan: Plan, h, attn_params, spec, cos, sin, positions,
              batch, axis, kv_cache, kv_len):
     """The attention block under the plan: tp_attn's MODES registry
     keyed by Plan.mode, prefill impl per Plan.attn_impl (None = the
-    planner's per-shape route_prefill_impl at the call site)."""
+    planner's per-shape route_prefill_impl at the call site), tile
+    configs and flash block per the plan's applied tune-cache winners
+    (module doc)."""
     from triton_dist_tpu.layers import tp_attn_fwd
 
+    kw = _site_configs(plan, plan.mode, "attn.ag", "attn.rs")
+    if plan.attn_block is not None:
+        kw["attn_block"] = plan.attn_block
     return tp_attn_fwd(
         h, attn_params, spec, cos, sin, positions, batch,
         axis=axis, mode=plan.mode, kv_cache=kv_cache, kv_len=kv_len,
-        attn_impl=plan.attn_impl,
+        attn_impl=plan.attn_impl, **kw,
     )
 
 
 def ffn_fwd(plan: Plan, h, params, axis, top_k=None):
     """The FFN block under the plan: tp_moe's registry keyed by
     Plan.moe_mode for MoE configs (which is where the planner may pick
-    the one-kernel fused pipeline), tp_mlp's keyed by Plan.mode."""
+    the one-kernel fused pipeline), tp_mlp's keyed by Plan.mode with the
+    plan's applied tune-cache winners threaded in (module doc; the MoE
+    registry lowerings pick their own chunking via plan_ep_chunks, which
+    consults the same cache)."""
     if plan.is_moe:
         from triton_dist_tpu.layers import tp_moe_fwd
 
@@ -73,4 +107,5 @@ def ffn_fwd(plan: Plan, h, params, axis, top_k=None):
                           mode=plan.moe_mode)
     from triton_dist_tpu.layers import tp_mlp_fwd
 
-    return tp_mlp_fwd(h, params, axis=axis, mode=plan.mode)
+    kw = _site_configs(plan, plan.mode, "mlp.ag", "mlp.rs")
+    return tp_mlp_fwd(h, params, axis=axis, mode=plan.mode, **kw)

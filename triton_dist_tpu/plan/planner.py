@@ -23,10 +23,20 @@ one decision per matched producer -> collective -> consumer triple:
              which is what keeps planned execution bit-identical to the
              hand path).
   configs    the autotuner's top-1 pruned tile config is recorded per
-             fused triple as the pricing witness; execution keeps the
-             kernels' own defaults so the bit-identity oracle holds
-             (threading plan configs into the kernels is the recorded
-             follow-up in ROADMAP).
+             fused triple as the pricing witness. What LAUNCHES is a
+             separate decision: a MEASURED winner from the persistent
+             tune cache (autotuner.TuneCache — same rig, shape bucket,
+             dtype, world and wire only) lands in
+             TripleDecision.applied_config and plan/execute threads it
+             into the kernel call, re-validated by the launch VMEM
+             gates (stale entries degrade loudly to the default). With
+             an empty cache every applied_config is "" and execution
+             compiles exactly the legacy default-tile program, so the
+             bit-identity oracle still gates the unoverridden world;
+             overridden launches are gated by the epsilon-band oracle
+             (verify/epsilon.py) instead — tile overrides reassociate
+             the fold order, so bitwise equality is the wrong contract
+             there.
 
 `plan_dense_forward` memoizes on the hashable (cfg, geometry, mode)
 tuple, so the model forward, `models/engine.Engine`, the serve
@@ -102,6 +112,15 @@ class TripleDecision:
               report can show the margin the decision rests on.
     config    autotuner top-1 tile config (pricing witness; see module
               doc).
+    applied_config   the config the launch actually overrides with
+              ("" = the kernel's own default tiles). Only a MEASURED
+              winner from the persistent tune cache lands here
+              (autotuner.TuneCache, same rig + shape-bucket + wire
+              only), re-validated against the launch-fit gates at plan
+              time — the model-ranked witness never launches un-measured,
+              so an empty cache compiles exactly the legacy program.
+    config_source    "" (default tiles) | "cache" (measured winner,
+              provenance in the cache entry's round stamp).
     """
 
     site: str
@@ -115,6 +134,8 @@ class TripleDecision:
     est_seq_ms: float
     config: str = ""
     reason: str = ""
+    applied_config: str = ""
+    config_source: str = ""
 
     @property
     def chosen_ms(self) -> float:
@@ -148,6 +169,12 @@ class Plan:
     decisions: Tuple[TripleDecision, ...]
     est_layer_ms: float
     mega_strategy: str = "least_loaded"
+    # measured flash-prefill KV page height from the tune cache (None =
+    # the kernel's default block; plan/execute threads it into the
+    # attention prefill fold). attn_block_source mirrors
+    # TripleDecision.config_source.
+    attn_block: Optional[int] = None
+    attn_block_source: str = ""
 
     @property
     def ffn_mode(self) -> str:
@@ -156,6 +183,29 @@ class Plan:
 
     def fused_sites(self) -> Tuple[str, ...]:
         return tuple(d.site for d in self.decisions if d.fused)
+
+    def applied_configs(self) -> dict:
+        """site -> (applied_config, source) for every decision that
+        launches a non-default config (plan_report's applied_config
+        column; Scheduler.metrics surfaces the count)."""
+        out = {d.site: (d.applied_config, d.config_source)
+               for d in self.decisions if d.applied_config}
+        if self.attn_block is not None:
+            out["attn.core"] = (f"FlashPrefillConfig(block={self.attn_block})",
+                                self.attn_block_source)
+        return out
+
+    def launch_config(self, site: str):
+        """The parsed config OBJECT a site launches with, or None for
+        the kernel default — the single accessor plan/execute threads
+        into the layer entry points."""
+        for d in self.decisions:
+            if d.site == site and d.applied_config:
+                from triton_dist_tpu import autotuner as at
+
+                return at.parse_config(_config_family(d.kernel),
+                                       d.applied_config)
+        return None
 
 
 @functools.lru_cache(maxsize=1)
@@ -204,6 +254,73 @@ def _top_config(pattern: str, cons_or_prod, world: int, chip) -> str:
         return ""
 
 
+def _config_family(kernel: str) -> str:
+    """Fused-kernel name -> the tune-cache family whose config class it
+    launches with (the grouped variants ride the dense families' config
+    dataclasses)."""
+    if kernel in ("ag_gemm", "ag_group_gemm", "fused_ag_moe_up"):
+        return "ag_gemm"
+    if kernel in ("gemm_rs", "moe_reduce_rs", "fused_moe_down_combine_rs"):
+        return "gemm_rs"
+    if kernel == "gemm_ar":
+        return "gemm_ar"
+    return kernel
+
+
+def _cached_config(kernel: str, node, world: int, chip, wire: str):
+    """Consult the persistent tune cache for a measured winner at this
+    fused site: same kernel family, shape bucket, dtype, world, wire
+    format AND rig only (autotuner.TuneCache — measured beats modeled,
+    never across rigs). A hit is re-validated against the launch-fit
+    gates with the SAME VMEM accounting the pruner admits configs by, so
+    a stale entry (code moved, chip changed) degrades LOUDLY to the
+    default tiles — never to a Mosaic allocation failure. Returns
+    (applied_config_repr, source): ("", "") = launch the default."""
+    from triton_dist_tpu import autotuner as at
+
+    family = _config_family(kernel)
+    if min(node.m, node.k, node.n) <= 0:
+        # degenerate geometry (e.g. fewer heads than ranks shards a
+        # projection to zero columns) — nothing to tune, and the fit
+        # gates divide by these dims
+        return "", ""
+    if family in ("ag_gemm", "gemm_rs", "gemm_ar"):
+        bucket = at.shape_bucket(node.m, node.k, node.n)
+    else:
+        return "", ""
+    entry = at.active_tune_cache().lookup(
+        family, bucket, node.dtype, world, wire,
+        at.rig_name(chip, world))
+    if entry is None:
+        return "", ""
+    try:
+        cfg = at.parse_config(family, entry["config"])
+    except ValueError as e:
+        warnings.warn(
+            f"plan: tune-cache entry for {node.name} is unparseable "
+            f"({e}); launching default tiles", stacklevel=2)
+        return "", ""
+    if family == "ag_gemm":
+        ok = at.ag_gemm_config_fits(cfg, node.m, node.k, node.n,
+                                    dtype=node.dtype, chip=chip)
+    elif world <= 1:
+        # the world=1 local blocked-matmul regime is what the sweeps
+        # measure; the ring regimes at world>1 fit their own tiles
+        ok = at.gemm_rs_local_config_fits(cfg, node.m, node.k, node.n,
+                                          dtype=node.dtype, chip=chip)
+    else:
+        ok = True
+    if not ok:
+        warnings.warn(
+            f"plan: cached {family} config {entry['config']!r} for "
+            f"{node.name} no longer passes the launch VMEM gate at "
+            f"(m={node.m}, k={node.k}, n={node.n}); launching default "
+            "tiles (stale tune cache — re-run the bench sweep)",
+            stacklevel=2)
+        return "", ""
+    return entry["config"], "cache"
+
+
 def _wire_name(node, world: int, chip, error_budget: float,
                collective: str) -> str:
     if not node.wire_eligible or world <= 1:
@@ -240,7 +357,7 @@ def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
                               config=config, reason=reason)
 
     def fused(lowered, kernel, proto, f_ms, s_ms, reason, wire,
-              config):
+              config, comp_node=None):
         if proto not in shipped and not forced:
             warnings.warn(
                 f"plan: fusion {tri.pattern!r} at {node.name} has no "
@@ -256,11 +373,15 @@ def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
                 f"plan: forced mode keeps unverified fusion "
                 f"{tri.pattern!r} at {node.name} (protocol {proto!r} "
                 f"not shipped)", stacklevel=2)
+        applied, source = ("", "") if comp_node is None else \
+            _cached_config(kernel, comp_node, world, chip, wire)
         return TripleDecision(site=node.name, pattern=tri.pattern,
                               lowered=lowered, fused=True,
                               kernel=kernel, protocol=proto, wire=wire,
                               est_fused_ms=f_ms, est_seq_ms=s_ms,
-                              config=config, reason=reason)
+                              config=config, reason=reason,
+                              applied_config=applied,
+                              config_source=source)
 
     if tri.pattern == "unknown":
         coll_ms = (pm.estimate_ag_ms(node.bytes, world, chip)
@@ -317,7 +438,7 @@ def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
                      PATTERN_PROTOCOLS[tri.pattern], f_ms, s_ms,
                      f"overlap hides min(comm, compute): "
                      f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
-                     wire, cfgstr)
+                     wire, cfgstr, comp_node=cons)
 
     if tri.pattern.endswith("+rs") or tri.pattern.endswith("+ar"):
         prod = nodes[tri.producer]
@@ -339,7 +460,7 @@ def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
                          f_ms, s_ms,
                          f"replicated lowering fuses the reduction: "
                          f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
-                         wire, cfgstr)
+                         wire, cfgstr, comp_node=prod)
         s_ms = gemm_ms + rs_ms
         f_ms = max(gemm_ms, rs_ms) + 0.1 * min(gemm_ms, rs_ms)
         if site_mode == "xla":
@@ -357,7 +478,7 @@ def _decide(ir: LayerIR, tri, mode: str, moe_mode: str, world: int,
                      PATTERN_PROTOCOLS[tri.pattern], f_ms, s_ms,
                      f"overlap hides min(comm, compute): "
                      f"{f_ms:.3f}ms vs {s_ms:.3f}ms sequential",
-                     wire, cfgstr)
+                     wire, cfgstr, comp_node=prod)
 
     # a2a+grouped_gemm (the EP plane) and anything future: the EP
     # chunked pipeline is planned by plan_ep_chunks; in a layer IR it
@@ -447,20 +568,66 @@ def plan_forward(ir: LayerIR, world: Optional[int] = None,
                                forced)
     est = (sum(d.chosen_ms for d in decisions)
            + _elementwise_ms(ir, chosen_mode, world, chip))
+    attn_block, blk_source = _cached_attn_block(ir, world, chip)
+    # applied configs enter the plan id: a cache hit compiles a
+    # DIFFERENT program than the default plan, so the stamp every
+    # consumer carries (Scheduler.metrics, mega Schedule) must move too
     pid = hashlib.sha1(repr((
         ir.key, world, chip.name, mode, chosen_mode, chosen_moe,
         attn_impl, error_budget,
+        tuple((d.site, d.applied_config) for d in decisions
+              if d.applied_config),
+        attn_block,
     )).encode()).hexdigest()[:12]
     return Plan(plan_id=pid, key=ir.key, world=world, chip=chip.name,
                 requested=mode, mode=chosen_mode, moe_mode=chosen_moe,
                 seq_sharded=chosen_mode in SEQ_SHARDED_MODES,
                 is_moe=ir.is_moe, attn_impl=attn_impl,
-                decisions=decisions, est_layer_ms=est)
+                decisions=decisions, est_layer_ms=est,
+                attn_block=attn_block, attn_block_source=blk_source)
+
+
+def _cached_attn_block(ir: LayerIR, world: int, chip):
+    """Measured flash-prefill KV page height for this step shape, from
+    the tune cache (same rig + shape-bucket only), re-validated against
+    the kernel's fit_block + VMEM gate. (None, "") = kernel default."""
+    from triton_dist_tpu import autotuner as at
+
+    attn = next((nd for nd in ir.nodes if nd.kind == "attention"), None)
+    if attn is None:
+        return None, ""
+    meta = dict(attn.meta or ())
+    s_q, t = meta.get("s_q", 0), meta.get("t", 0)
+    hq, hkv, d = meta.get("hq", 0), meta.get("hkv", 0), meta.get("d", 0)
+    if not (s_q > 1 and t and hq and hkv and d):
+        return None, ""  # decode / malformed meta: nothing to prefill
+    entry = at.active_tune_cache().lookup(
+        "flash_prefill", at.shape_bucket(s_q, t, hq, hkv, d),
+        attn.dtype, world, "native", at.rig_name(chip, world))
+    if entry is None:
+        return None, ""
+    try:
+        cfg = at.parse_config("flash_prefill", entry["config"])
+    except ValueError as e:
+        warnings.warn(
+            f"plan: tune-cache flash_prefill entry is unparseable "
+            f"({e}); launching default block", stacklevel=2)
+        return None, ""
+    if not at.flash_prefill_config_fits(cfg, s_q, t, hq, hkv, d,
+                                        dtype=attn.dtype,
+                                        batch=meta.get("batch", 1),
+                                        chip=chip):
+        warnings.warn(
+            f"plan: cached flash_prefill block {cfg.block} no longer "
+            f"passes the launch VMEM gate at (s_q={s_q}, t={t}); "
+            "launching default block (stale tune cache)", stacklevel=2)
+        return None, ""
+    return int(cfg.block), "cache"
 
 
 @functools.lru_cache(maxsize=512)
 def _plan_dense_cached(cfg, batch, seq, world, mode, attn_impl, kv_len,
-                       rig, error_budget):
+                       rig, error_budget, tune_gen):
     ir = build_dense_ir(cfg, batch, seq, world, kv_len=kv_len)
     return plan_forward(ir, world=world, rig=rig, mode=mode,
                         attn_impl=attn_impl, error_budget=error_budget)
@@ -475,11 +642,16 @@ def plan_dense_forward(cfg, batch: int, seq: int, world: int,
     """Plan one `models/dense.forward` step shape. Memoized on the
     hashable ModelConfig + geometry, so every consumer of the same step
     shape holds the SAME Plan object (module doc) and planning inside a
-    traced function costs a dict lookup."""
+    traced function costs a dict lookup. The tune-cache generation
+    enters the memo key: a plan built before the cache was populated
+    (or swapped by a test/bench arm) never masks a measured winner."""
+    from triton_dist_tpu import autotuner as at
+
     if rig is None:
         rig = _resolve_chip(None).name
     return _plan_dense_cached(cfg, batch, seq, world, mode, attn_impl,
-                              kv_len, rig, error_budget)
+                              kv_len, rig, error_budget,
+                              at.tune_cache_generation())
 
 
 def plan_ep_chunks(m: int, hidden: int, inter: int, e_loc: int, n: int,
@@ -489,11 +661,26 @@ def plan_ep_chunks(m: int, hidden: int, inter: int, e_loc: int, n: int,
     """ONE EP chunking entry (the a2a+grouped_gemm plane):
     `layers/ep_moe.py`'s n_chunks auto path routes here so the planner
     owns the composition; `perf_model.choose_ep_chunks` stays the
-    pricing primitive."""
+    pricing primitive. A measured winner in the tune cache (kernel
+    "ep_moe", same rig + shape bucket) beats the modeled pick — the
+    chunk count is re-fitted by the kernel's own fit_chunks at launch,
+    so a stale entry degrades to a legal schedule, never a crash."""
     import jax.numpy as jnp
 
+    from triton_dist_tpu import autotuner as at
     from triton_dist_tpu.perf_model import choose_ep_chunks
 
+    entry = at.active_tune_cache().lookup(
+        "ep_moe", at.shape_bucket(m, hidden, inter, e_loc, top_k),
+        jnp.bfloat16 if dtype is None else dtype, n, "native",
+        at.rig_name(chip, n))
+    if entry is not None:
+        try:
+            return int(at.parse_config("ep_moe", entry["config"]).n_chunks)
+        except ValueError as e:
+            warnings.warn(
+                f"plan: tune-cache ep_moe entry is unparseable ({e}); "
+                "using the modeled chunk count", stacklevel=2)
     return choose_ep_chunks(
         m, hidden, inter, e_loc, n, top_k, capacity=capacity,
         dtype=jnp.bfloat16 if dtype is None else dtype,

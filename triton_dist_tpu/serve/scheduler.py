@@ -1200,6 +1200,9 @@ class Scheduler:
         out["migrations_pending_admit"] = len(self._pending_migrations)
         if self.plan is not None:
             out["plan_id"] = self.plan.plan_id
+            # tune-cache winners riding this plan (site -> config via
+            # Plan.applied_configs); 0 = every kernel on default tiles
+            out["plan_applied_configs"] = len(self.plan.applied_configs())
         if self.resident:
             out["resident_windows"] = snap.get(
                 "serve_resident_windows", 0)

@@ -1,0 +1,140 @@
+"""Epsilon-band numeric oracle for config-overridden kernel launches.
+
+The tier-1 acceptance oracle is bitwise: every fused path must equal the
+hand-routed path it rewrites (plan/execute module doc). That oracle is
+exactly right for `config=None` — the default tiles compile the same
+program — and exactly wrong for a tuned launch: overriding tile shapes
+changes the floating-point FOLD ORDER (a different tile_k splits the K
+reduction differently; a different flash block folds KV pages in a
+different association), so the overridden result is a different — equally
+valid — rounding of the same exact sum. Gating tuned launches bitwise
+would forbid tuning; gating them not at all would let a wrong-result
+kernel hide behind "it's just reassociation".
+
+This module is the middle: per-(kernel-family, dtype) drift BANDS in the
+`wire/numerics.py` harness discipline — cosine drift (direction error of
+the flattened f64 views) plus max-ulp distance (sign-aware monotone int
+map of the f32 views) — sized so that any reassociation of the shipped
+kernels' reductions passes with an order of magnitude of headroom, while
+a dropped K block, a masked-out row, or a transposed operand lands
+orders of magnitude outside (tests/test_tuning_loop.py pins both
+polarities). Budgets are pinned per kernel family, NOT derived from the
+observed value — a band that chases the measurement cannot fail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from triton_dist_tpu.wire.numerics import cosine_drift, max_ulp_f32
+
+
+@dataclasses.dataclass(frozen=True)
+class EpsilonBand:
+    """Maximum tolerated drift between a default-config and an
+    overridden-config launch of the same kernel on the same inputs."""
+
+    cos: float  # cosine drift of the flattened f64 views
+    ulp: int    # max per-element ulp distance of the f32 views
+
+    def admits(self, drift: dict) -> bool:
+        return drift["cos"] <= self.cos and drift["ulp"] <= self.ulp
+
+
+# (kernel family, dtype name) -> band, judged on the SCALE-FLOORED ulp
+# view (see drift): bf16 keeps ~8 mantissa bits, so one bf16 quantum is
+# 65536 f32 ulps — the bf16 budgets tolerate a few quanta of fold-order
+# movement, not a wrong answer. f32 reassociation moves above-floor
+# elements by a relative O(K*eps) of the tensor scale, which the floored
+# ulp map reads as a few 10^4 — the 2^20 budget gives ~50x headroom
+# while a wrong answer (O(1) relative movement of the LARGE elements —
+# a dropped K block, a masked row) reads >= 2^23 and lands outside both
+# numbers at once (tests/test_tuning_loop.py pins both polarities).
+# The cos budgets follow wire/numerics.DEFAULT_ERROR_BUDGET (5e-3, the
+# lossy-WIRE ceiling) scaled down 10x: a tile override must cost well
+# under what a quantized codec is allowed to.
+_BANDS = {
+    ("ag_gemm", "bfloat16"): EpsilonBand(cos=5e-4, ulp=8 << 16),
+    ("ag_gemm", "float32"): EpsilonBand(cos=1e-6, ulp=1 << 20),
+    ("gemm_rs", "bfloat16"): EpsilonBand(cos=5e-4, ulp=8 << 16),
+    ("gemm_rs", "float32"): EpsilonBand(cos=1e-6, ulp=1 << 20),
+    ("flash_prefill", "bfloat16"): EpsilonBand(cos=5e-4, ulp=8 << 16),
+    ("flash_prefill", "float32"): EpsilonBand(cos=1e-6, ulp=1 << 20),
+}
+# dtype fallback for families without a pinned row: the loosest shipped
+# band of that dtype (adding a family should still pin its own row).
+_DTYPE_FALLBACK = {
+    "bfloat16": EpsilonBand(cos=5e-4, ulp=8 << 16),
+    "float32": EpsilonBand(cos=1e-6, ulp=1 << 20),
+}
+
+# Elements whose magnitude is below scale * 2^-12 in BOTH tensors are
+# flushed to zero before the ulp map: a zero-mean reduction leaves
+# near-zero elements whose value is pure cancellation noise, and the ulp
+# distance between two noise values is unbounded (the int map is densest
+# around zero) without saying anything about correctness. The floor is
+# relative to the REFERENCE tensor's max magnitude, so a wrong result
+# that zeroes or rescales the large elements is never excused — only
+# one-sided tininess keeps an element in the comparison.
+_ULP_FLOOR_REL = 2.0 ** -12
+
+
+def band_for(kernel: str, dtype) -> EpsilonBand:
+    name = np.dtype(dtype).name
+    band = _BANDS.get((kernel, name)) or _DTYPE_FALLBACK.get(name)
+    if band is None:
+        raise KeyError(
+            f"no epsilon band for ({kernel!r}, {name!r}) — pin one in "
+            "verify/epsilon._BANDS before shipping a tuned launch at "
+            "this dtype")
+    return band
+
+
+def drift(ref, got) -> dict:
+    """The two-number drift summary between a reference and an
+    overridden launch — the `wire/numerics._drift` shape, so epsilon
+    reports read like the wire-harness tables."""
+    a = np.asarray(ref)
+    b = np.asarray(got)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    af = a.astype(np.float32)
+    bf = b.astype(np.float32)
+    floor = float(np.max(np.abs(af))) * _ULP_FLOOR_REL if af.size else 0.0
+    noise = (np.abs(af) < floor) & (np.abs(bf) < floor)
+    return {
+        "cos": float(cosine_drift(a, b)),
+        "ulp": int(max_ulp_f32(np.where(noise, np.float32(0), af),
+                               np.where(noise, np.float32(0), bf))),
+    }
+
+
+def check_epsilon(ref, got, kernel: str, dtype=None) -> dict:
+    """Measure drift and judge it against the family band. Returns
+    {"ok", "cos", "ulp", "band_cos", "band_ulp", "kernel", "dtype"}."""
+    dtype = np.asarray(ref).dtype if dtype is None else dtype
+    band = band_for(kernel, dtype)
+    d = drift(ref, got)
+    return {
+        "ok": band.admits(d),
+        "cos": d["cos"],
+        "ulp": d["ulp"],
+        "band_cos": band.cos,
+        "band_ulp": band.ulp,
+        "kernel": kernel,
+        "dtype": np.dtype(dtype).name,
+    }
+
+
+def assert_epsilon(ref, got, kernel: str, dtype=None) -> dict:
+    """check_epsilon that raises with the full report on violation —
+    the oracle tests and the bench arms call this form."""
+    rep = check_epsilon(ref, got, kernel, dtype=dtype)
+    assert rep["ok"], (
+        f"epsilon-band violation for {kernel} ({rep['dtype']}): "
+        f"cos={rep['cos']:.3e} (band {rep['band_cos']:.0e}), "
+        f"ulp={rep['ulp']} (band {rep['band_ulp']}) — a config override "
+        "may reassociate, never change, the result")
+    return rep
